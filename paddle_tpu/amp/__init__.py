@@ -1,0 +1,254 @@
+"""Automatic mixed precision.
+
+Parity with the reference's AMP stack (upstream layout: python/paddle/amp/ —
+``auto_cast``, ``GradScaler``, ``decorate``, white/black op lists, O1/O2
+levels, master weights).  TPU-first notes:
+
+  * The natural TPU compute dtype is **bfloat16** — same exponent range as
+    fp32 — so loss scaling is unnecessary there; :class:`GradScaler` is fully
+    implemented (scale / unscale / found-inf skip / dynamic scale update,
+    matching the reference's semantics in python/paddle/amp/grad_scaler.py,
+    upstream layout) for fp16 paths; pass ``enable=False`` for bf16 training.
+  * O1 ≙ per-op autocast: white-listed ops (the MXU ops: matmul, conv,
+    attention) run in the cast dtype, black-listed ops (softmax/log/norms/
+    reductions) stay fp32.  O2 ≙ cast the whole model's params once
+    (:func:`decorate`) and keep fp32 master weights in the optimizer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as _dtype_mod
+
+__all__ = ["auto_cast", "autocast", "GradScaler", "decorate",
+           "get_policy", "compute_dtype", "WHITE_LIST", "BLACK_LIST"]
+
+# ops that benefit from bf16 on the MXU (reference: paddle/fluid/eager/amp_utils.h
+# + python/paddle/amp/amp_lists.py, upstream layout)
+WHITE_LIST: Set[str] = {
+    "matmul", "linear", "conv2d", "conv1d", "einsum", "attention",
+    "flash_attention", "bmm", "mm",
+}
+# numerically sensitive ops kept in fp32
+BLACK_LIST: Set[str] = {
+    "softmax", "log_softmax", "cross_entropy", "layer_norm", "rms_norm",
+    "group_norm", "batch_norm", "log", "exp", "sum", "mean", "norm",
+    "cumsum", "softplus",
+}
+
+_state = threading.local()
+
+
+class _Policy:
+    __slots__ = ("enable", "dtype", "level", "white", "black")
+
+    def __init__(self, enable, dtype, level, white, black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+def get_policy() -> Optional[_Policy]:
+    return getattr(_state, "policy", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16"):
+    """Context under which white-listed functional ops compute in ``dtype``."""
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    pol = _Policy(enable, _dtype_mod.to_jax_dtype(dtype), level, white, black)
+    prev = get_policy()
+    _state.policy = pol
+    try:
+        yield
+    finally:
+        _state.policy = prev
+
+
+autocast = auto_cast  # alias
+
+
+def compute_dtype(op_name: str, *xs):
+    """Dtype an op should compute in under the active autocast policy.
+
+    Returns None when no cast should happen (no policy / black-listed /
+    non-float inputs).
+    """
+    pol = get_policy()
+    if pol is None or not pol.enable:
+        return None
+    if op_name in pol.black or op_name not in pol.white:
+        return None
+    for x in xs:
+        if x is not None and hasattr(x, "dtype") and not jnp.issubdtype(
+                x.dtype, jnp.floating):
+            return None
+    return pol.dtype
+
+
+def _cast(x, dt):
+    if x is None or dt is None:
+        return x
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) and (
+            x.dtype != dt):
+        return x.astype(dt)
+    return x
+
+
+def cast_inputs(op_name: str, *xs):
+    """Cast op inputs per policy; returns (cast_inputs..., out_cast_dtype)."""
+    dt = compute_dtype(op_name, *xs)
+    if dt is None:
+        return xs
+    return tuple(_cast(x, dt) for x in xs)
+
+
+class GradScaler:
+    """Dynamic loss scaler (parity: ``paddle.amp.GradScaler``).
+
+    Functional usage for jit-compiled steps::
+
+        state = scaler.init_state()
+        scaled = scaler.scale_with(state, loss)
+        grads  = jax.grad(...)                       # grads of scaled loss
+        grads, found_inf = scaler.unscale_with(state, grads)
+        state  = scaler.update_state(state, found_inf)
+        # skip the optimizer update where found_inf (jnp.where in the caller)
+
+    The imperative API (``scale``/``unscale_``/``step``/``update``) mirrors the
+    reference for eager-mode use.
+    """
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._init_scale = float(init_loss_scaling)
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        self._state = self.init_state()
+        self._found_inf = jnp.zeros((), jnp.bool_)
+        self._unscaled = False
+
+    # -- functional core ----------------------------------------------------
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        return {
+            "scale": jnp.asarray(self._init_scale if self._enable else 1.0,
+                                 jnp.float32),
+            "good_steps": jnp.zeros((), jnp.int32),
+            "bad_steps": jnp.zeros((), jnp.int32),
+        }
+
+    def scale_with(self, state, loss):
+        if not self._enable:
+            return loss
+        return loss * state["scale"].astype(loss.dtype)
+
+    def unscale_with(self, state, grads):
+        if not self._enable:
+            found = jnp.zeros((), jnp.bool_)
+            return grads, found
+        inv = (1.0 / state["scale"]).astype(jnp.float32)
+        leaves = jax.tree_util.tree_leaves(grads)
+        found = jnp.zeros((), jnp.bool_)
+        for g in leaves:
+            found = found | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+        grads = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        return grads, found
+
+    def update_state(self, state, found_inf):
+        if not (self._enable and self.dynamic):
+            return state
+        scale, good, bad = state["scale"], state["good_steps"], state["bad_steps"]
+        bad = jnp.where(found_inf, bad + 1, jnp.zeros_like(bad))
+        good = jnp.where(found_inf, jnp.zeros_like(good), good + 1)
+        shrink = bad >= self.decr_every_n_nan_or_inf
+        grow = good >= self.incr_every_n_steps
+        scale = jnp.where(shrink, scale * self.decr_ratio, scale)
+        scale = jnp.where(grow, scale * self.incr_ratio, scale)
+        bad = jnp.where(shrink, jnp.zeros_like(bad), bad)
+        good = jnp.where(grow, jnp.zeros_like(good), good)
+        return {"scale": scale, "good_steps": good, "bad_steps": bad}
+
+    # -- imperative mirror (reference API) -----------------------------------
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, loss):
+        return self.scale_with(self._state, loss)
+
+    def unscale_(self, grads):
+        grads, found = self.unscale_with(self._state, grads)
+        self._found_inf = found
+        self._unscaled = True
+        return grads
+
+    def step(self, optimizer, grads):
+        """Unscale (if the caller didn't) and apply the optimizer step unless
+        inf/nan was found — matching the reference's GradScaler.step, which
+        unscales internally (python/paddle/amp/grad_scaler.py)."""
+        if not self._unscaled:
+            grads = self.unscale_(grads)
+        if bool(self._found_inf):
+            return
+        optimizer.step(grads)
+
+    def minimize(self, optimizer, grads):  # reference-parity alias
+        self.step(optimizer, grads)
+        self.update()
+
+    def update(self):
+        self._state = self.update_state(self._state, self._found_inf)
+        self._found_inf = jnp.zeros((), jnp.bool_)
+        self._unscaled = False
+
+    @property
+    def loss_scaling(self):
+        return self._state["scale"]
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None):
+    """O2 decoration: cast model floating params to ``dtype``; the optimizer
+    keeps fp32 master weights (parity: ``paddle.amp.decorate``)."""
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        for m in ms:
+            m.astype(dtype)
+    if optimizers is not None:
+        single_o = not isinstance(optimizers, (list, tuple))
+        os_ = [optimizers] if single_o else list(optimizers)
+        for o in os_:
+            if master_weight is not False:
+                o._multi_precision = True
+        if single_o:
+            optimizers = os_[0]
+    if single:
+        ms = ms[0]
+    return (ms, optimizers) if optimizers is not None else ms

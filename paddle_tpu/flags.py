@@ -1,0 +1,104 @@
+"""Runtime flag registry.
+
+TPU-native equivalent of the reference's gflags-style flag system
+(upstream layout: paddle/common/flags.cc — ``PHI_DEFINE_EXPORTED_*`` macros,
+surfaced to Python as ``paddle.set_flags``/``paddle.get_flags`` and ``FLAGS_*``
+environment variables).  Here the registry is pure Python: flags are declared
+with :func:`DEFINE`, overridable via ``FLAGS_<name>`` environment variables at
+import time, and a few of them bridge onto ``jax.config`` knobs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["DEFINE", "get_flags", "set_flags", "flag"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    help: str
+    # optional hook run on set (e.g. to forward onto jax.config)
+    on_set: Optional[Callable[[Any], None]] = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _coerce(default: Any, raw: str) -> Any:
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def DEFINE(name: str, default: Any, help: str = "",
+           on_set: Optional[Callable[[Any], None]] = None) -> None:
+    """Declare a flag. ``FLAGS_<name>`` in the environment overrides the default."""
+    value = default
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        value = _coerce(default, env)
+    f = _Flag(name, default, value, help, on_set)
+    _REGISTRY[name] = f
+    if on_set is not None and value != default:
+        on_set(value)
+
+
+def flag(name: str) -> Any:
+    """Read one flag's current value."""
+    return _REGISTRY[name].value
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    """Mirror of ``paddle.get_flags``: dict of flag name -> value."""
+    if names is None:
+        return {k: f.value for k, f in _REGISTRY.items()}
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[n].value for n in names}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Mirror of ``paddle.set_flags``."""
+    for name, value in flags.items():
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown flag {name!r}; DEFINE it first")
+        f = _REGISTRY[name]
+        f.value = value
+        if f.on_set is not None:
+            f.on_set(value)
+
+
+# ---------------------------------------------------------------------------
+# Core flags (parity with the reference's most-used FLAGS_*)
+# ---------------------------------------------------------------------------
+
+def _set_jax_x64(v: bool) -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", bool(v))
+
+
+DEFINE("check_nan_inf", False, "check outputs for nan/inf after each op (debug)")
+DEFINE("call_stack_level", 1, "error-message verbosity level")
+DEFINE("use_fast_math", True, "allow fastmath-style approximations in kernels")
+DEFINE("enable_x64", False, "enable 64-bit types (maps onto jax_enable_x64)",
+       on_set=_set_jax_x64)
+DEFINE("matmul_precision", "default",
+       "default|float32|tensorfloat32|highest — XLA matmul precision")
+DEFINE("log_level", 0, "VLOG-style verbosity for paddle_tpu's own logging")
+DEFINE("allocator_strategy", "xla",
+       "parity flag: the reference exposes auto_growth; on TPU, XLA owns memory")
+DEFINE("pallas_interpret", False,
+       "run Pallas kernels in interpreter mode (for CPU tests)")
+DEFINE("flash_attention_block_q", 512, "Pallas flash-attention q block size")
+DEFINE("flash_attention_block_kv", 512, "Pallas flash-attention kv block size")

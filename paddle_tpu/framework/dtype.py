@@ -1,0 +1,61 @@
+"""Dtype registry and default-dtype management.
+
+Parity with the reference's ``paddle.set_default_dtype``/``get_default_dtype``
+(upstream layout: python/paddle/framework/framework.py) plus the PHI dtype enum
+(paddle/phi/common/data_type.h).  On TPU the interesting dtypes are float32,
+bfloat16 (MXU-native) and int8/fp8 for quantized paths.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "set_default_dtype", "get_default_dtype", "to_jax_dtype",
+    "float16", "bfloat16", "float32", "float64",
+    "int8", "int16", "int32", "int64", "uint8", "bool_",
+]
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+
+_ALIASES = {
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+    "float64": jnp.float64, "fp64": jnp.float64, "double": jnp.float64,
+    "int8": jnp.int8, "int16": jnp.int16, "int32": jnp.int32,
+    "int64": jnp.int64, "uint8": jnp.uint8, "bool": jnp.bool_,
+}
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    _default_dtype = to_jax_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def to_jax_dtype(d):
+    """Normalise str / numpy / jax dtype spellings to a jnp dtype."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        try:
+            return _ALIASES[d]
+        except KeyError:
+            raise ValueError(f"unknown dtype {d!r}") from None
+    return jnp.dtype(d).type if isinstance(d, np.dtype) else d

@@ -1,0 +1,153 @@
+"""RNG management: global seed, per-call-site keys, and a parallel RNG tracker.
+
+TPU-native equivalent of the reference's RNG stack:
+  * ``paddle.seed`` (python/paddle/framework/random.py, upstream layout)
+  * the model-parallel RNG state tracker
+    (python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py —
+    ``RNGStatesTracker`` / ``get_rng_state_tracker``), which gives tensor-parallel
+    ranks *different* dropout streams inside parallel regions but *identical*
+    streams elsewhere.
+
+Design (jax-first):
+  * Eager mode: a global PRNG key advanced by a Python-side split counter.
+  * Traced/jit mode: code must run inside :class:`rng_guard`, which pins a key
+    passed in as a traced argument; every stochastic call site derives
+    ``fold_in(key, site_counter)`` where the counter is advanced at *trace*
+    time, so each site gets a distinct, step-varying stream without any Python
+    state inside the compiled computation.
+  * Parallel regions: :class:`RNGStatesTracker` folds a named offset (and, when
+    inside ``shard_map``, the mesh-axis index via ``jax.lax.axis_index``) into
+    the site key, reproducing the reference's same/different-stream semantics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "seed", "get_global_key", "next_key", "site_key", "rng_guard",
+    "RNGStatesTracker", "get_rng_state_tracker",
+]
+
+_state = threading.local()
+
+
+def _globals():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+        _state.counter = 0
+        _state.guard = None  # type: Optional[_RngGuard]
+    return _state
+
+
+def seed(s: int) -> None:
+    """Set the global seed (parity: ``paddle.seed``)."""
+    g = _globals()
+    g.key = jax.random.key(int(s))
+    g.counter = 0
+
+
+def get_global_key():
+    return _globals().key
+
+
+def next_key():
+    """Eager-mode fresh key: splits the global key (stateful; not for jit)."""
+    g = _globals()
+    g.counter += 1
+    return jax.random.fold_in(g.key, g.counter)
+
+
+class _RngGuard:
+    __slots__ = ("key", "counter", "prev")
+
+    def __init__(self, key):
+        self.key = key
+        self.counter = 0
+        self.prev = None
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Pin the RNG key for a functional/traced region.
+
+    Inside the guard every :func:`site_key` call derives a unique per-site key
+    from ``key``; the per-site offsets are fixed at trace time so recompilation
+    is not triggered and streams differ across sites but are reproducible.
+    """
+    g = _globals()
+    guard = _RngGuard(key)
+    guard.prev = g.guard
+    g.guard = guard
+    try:
+        yield
+    finally:
+        g.guard = guard.prev
+
+
+def site_key():
+    """Key for one stochastic call site (dropout, init noise, ...)."""
+    g = _globals()
+    if g.guard is not None:
+        g.guard.counter += 1
+        return jax.random.fold_in(g.guard.key, g.guard.counter)
+    return next_key()
+
+
+def in_rng_guard() -> bool:
+    return _globals().guard is not None
+
+
+# ---------------------------------------------------------------------------
+# Parallel RNG tracker (parity with fleet's RNGStatesTracker)
+# ---------------------------------------------------------------------------
+
+class RNGStatesTracker:
+    """Named RNG streams for parallel regions.
+
+    ``tracker.add("model_parallel_rng", seed)`` registers a stream; code inside
+    ``with tracker.rng_state("model_parallel_rng"):`` draws keys from that
+    stream.  When ``axis_name`` is given and the code runs inside ``shard_map``
+    over a mesh, the mesh position is folded in so each shard gets a distinct
+    stream — the TPU-native analogue of per-tensor-parallel-rank dropout seeds.
+    """
+
+    def __init__(self):
+        self._seeds = {}
+
+    def reset(self):
+        self._seeds.clear()
+
+    def add(self, name: str, seed_: int):
+        if name in self._seeds:
+            raise ValueError(f"rng state {name!r} already added")
+        self._seeds[name] = int(seed_)
+
+    def get_states_tracker(self):
+        return dict(self._seeds)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel_rng",
+                  axis_name: Optional[str] = None):
+        if name not in self._seeds:
+            raise ValueError(f"rng state {name!r} not added")
+        g = _globals()
+        base = g.guard.key if g.guard is not None else g.key
+        k = jax.random.fold_in(base, self._seeds[name])
+        if axis_name is not None:
+            # distinct stream per position along the mesh axis (traced value)
+            k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+        with rng_guard(k):
+            yield
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER

@@ -1,0 +1,19 @@
+"""paddle_tpu.nn — the user-facing layer API (parity: ``paddle.nn``)."""
+
+from . import functional, initializer
+from .common import (GELU, Dropout, Embedding, GroupNorm, Identity,
+                     LayerNorm, Linear, ReLU, RMSNorm, Sigmoid, SiLU,
+                     Softmax, Tanh)
+from .conv import AvgPool2D, Conv2D, MaxPool2D
+from .layer import Layer, LayerList, Parameter, Sequential, functional_call
+from .transformer import (FeedForward, MultiHeadAttention, TransformerEncoder,
+                          TransformerEncoderLayer)
+
+__all__ = [
+    "functional", "initializer", "Layer", "LayerList", "Parameter",
+    "Sequential", "functional_call", "Linear", "Embedding", "Dropout",
+    "ReLU", "GELU", "SiLU", "Sigmoid", "Tanh", "Softmax", "LayerNorm",
+    "RMSNorm", "GroupNorm", "Identity", "Conv2D", "MaxPool2D", "AvgPool2D",
+    "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+    "FeedForward",
+]

@@ -1,0 +1,194 @@
+"""Core layers: Linear, Embedding, Dropout, activations-as-layers, norms.
+
+Parity with the reference's ``paddle.nn`` layer classes (upstream layout:
+python/paddle/nn/layer/common.py, .../norm.py).  Layers optionally carry a
+``PartitionSpec`` per parameter (``weight_sharding=...``) — the GSPMD-native
+replacement for the reference's per-layer dist attrs; pjit reads them via
+``Layer.param_shardings()``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework import dtype as _dtype_mod
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = [
+    "Linear", "Embedding", "Dropout", "ReLU", "GELU", "SiLU", "Sigmoid",
+    "Tanh", "Softmax", "LayerNorm", "RMSNorm", "GroupNorm", "Identity",
+]
+
+
+class Linear(Layer):
+    """y = xW + b with W of shape (in_features, out_features) — the
+    reference's weight layout (python/paddle/nn/layer/common.py: Linear)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 weight_attr=None, bias_attr=None, dtype=None,
+                 weight_sharding=None, bias_sharding=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        w_init = weight_attr if weight_attr is not None else I.XavierNormal()
+        self.weight = self.create_parameter(
+            (in_features, out_features), dtype=dtype, initializer=w_init,
+            sharding=weight_sharding, attr_name="weight")
+        if bias and bias_attr is not False:
+            b_init = bias_attr if bias_attr is not None else I.Constant(0.0)
+            self.bias = self.create_parameter(
+                (out_features,), dtype=dtype, initializer=b_init,
+                sharding=bias_sharding, attr_name="bias")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, weight_attr=None,
+                 dtype=None, weight_sharding=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        w_init = weight_attr if weight_attr is not None else I.Normal(std=0.02)
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), dtype=dtype, initializer=w_init,
+            sharding=weight_sharding, attr_name="weight")
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight, self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, axis=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, axis=self.axis)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class GELU(Layer):
+    def __init__(self, approximate: bool = False):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, approximate=self.approximate)
+
+
+class SiLU(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return F.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return F.tanh(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None, dtype=None,
+                 weight_sharding=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self.normalized_shape, dtype=dtype,
+                initializer=weight_attr or I.Constant(1.0),
+                sharding=weight_sharding, attr_name="weight")
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                self.normalized_shape, dtype=dtype,
+                initializer=bias_attr or I.Constant(0.0),
+                sharding=weight_sharding, attr_name="bias")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+
+class RMSNorm(Layer):
+    """RMSNorm layer (the reference exposes it via fused_rms_norm in
+    paddle.incubate; first-class here since every Llama-family model uses it)."""
+
+    def __init__(self, hidden_size: int, epsilon: float = 1e-6, dtype=None,
+                 weight_sharding=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), dtype=dtype, initializer=I.Constant(1.0),
+            sharding=weight_sharding, attr_name="weight")
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups: int, num_channels: int,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 dtype=None, data_format: str = "NCHW"):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                (num_channels,), dtype=dtype,
+                initializer=weight_attr or I.Constant(1.0), attr_name="weight")
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_channels,), dtype=dtype,
+                initializer=bias_attr or I.Constant(0.0), attr_name="bias")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias,
+                            self.epsilon, self.data_format)

@@ -1,0 +1,57 @@
+"""Convolution layers (parity: python/paddle/nn/layer/conv.py, upstream
+layout).  NCHW default like the reference; weights are (out_c, in_c/groups,
+kh, kw)."""
+
+from __future__ import annotations
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["Conv2D", "MaxPool2D", "AvgPool2D"]
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias: bool = True, weight_attr=None, bias_attr=None,
+                 dtype=None, data_format: str = "NCHW",
+                 weight_sharding=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.data_format = data_format
+        w_init = weight_attr if weight_attr is not None else I.KaimingUniform()
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *k), dtype=dtype,
+            initializer=w_init, sharding=weight_sharding, attr_name="weight")
+        if bias and bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), dtype=dtype,
+                initializer=bias_attr or I.Constant(0.0), attr_name="bias")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)

@@ -1,0 +1,358 @@
+"""Functional neural-net ops.
+
+Parity with the reference's ``paddle.nn.functional`` (upstream layout:
+python/paddle/nn/functional/) with kernels provided by XLA via jax.numpy/lax —
+the TPU-native replacement for PHI's CPU/GPU kernels
+(paddle/phi/kernels/{cpu,gpu}/, upstream layout).  Hot fused paths (flash
+attention with LSE, fused rope, rms_norm) live in :mod:`paddle_tpu.ops` as
+Pallas kernels; these functions route to them when available.
+
+All ops consult the active AMP policy (paddle_tpu.amp) — white-listed MXU ops
+cast to the policy dtype, mirroring the reference's eager AMP hooks
+(paddle/fluid/eager/amp_utils.h, upstream layout).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import amp as _amp
+from ..framework import random as _random
+
+__all__ = [
+    "linear", "embedding", "relu", "gelu", "silu", "swish", "sigmoid",
+    "tanh", "softmax", "log_softmax", "softplus", "leaky_relu", "swiglu",
+    "dropout", "layer_norm", "rms_norm", "group_norm",
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "one_hot",
+    "scaled_dot_product_attention", "conv2d", "max_pool2d", "avg_pool2d",
+    "pad", "unfold", "interpolate",
+]
+
+
+# ---------------------------------------------------------------------------
+# dense / embedding
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b).  Weight layout is (in_features, out_features) — the
+    reference's convention (python/paddle/nn/functional/common.py: linear)."""
+    x, weight, bias = _amp.cast_inputs("linear", x, weight, bias)
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(ids, weight, padding_idx: Optional[int] = None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        if padding_idx < 0:  # reference accepts [-num_embeddings, num_embeddings)
+            padding_idx += weight.shape[0]
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, jnp.zeros((), out.dtype))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.logaddexp(bx, 0.0) / beta)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def swiglu(x, y=None):
+    """SwiGLU gate (parity: paddle.incubate.nn.functional.swiglu — used by the
+    reference's Llama MLP).  With one argument, splits it in half."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return silu(x) * y
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+def dropout(x, p: float = 0.5, training: bool = True, axis=None):
+    """Inverted dropout; RNG from the framework's site-key discipline so it is
+    reproducible under jit (see paddle_tpu/framework/random.py)."""
+    if not training or p == 0.0:
+        return x
+    if p >= 1.0:
+        return jnp.zeros_like(x)
+    key = _random.site_key()
+    shape = x.shape
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# normalisation — computed in fp32 regardless of input dtype (TPU practice;
+# the reference's LayerNormKernel likewise accumulates in fp32)
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon: float = 1e-5):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - (len(normalized_shape)
+                                 if normalized_shape else 1), x.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dt)
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    """RMSNorm (parity: paddle.incubate.nn.functional.fused_rms_norm)."""
+    from ..ops import rms_norm as _rms_norm_op
+    return _rms_norm_op(x, weight, epsilon)
+
+
+def group_norm(x, num_groups: int, weight=None, bias=None,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    dt = x.dtype
+    xf = x.astype(jnp.float32).reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(n, c, *spatial)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32).reshape(1, c, *([1] * len(spatial)))
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(1, c, *([1] * len(spatial)))
+    y = y.astype(dt)
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def one_hot(ids, num_classes: int, dtype=jnp.float32):
+    return jax.nn.one_hot(ids, num_classes, dtype=dtype)
+
+
+def cross_entropy(logits, labels, ignore_index: int = -100,
+                  reduction: str = "mean", label_smoothing: float = 0.0,
+                  soft_label: bool = False, axis: int = -1):
+    """Softmax cross entropy (parity: ``F.cross_entropy``,
+    python/paddle/nn/functional/loss.py, upstream layout).
+
+    Computed in fp32 via log-softmax for bf16 safety.  ``labels`` are class
+    ids unless ``soft_label`` is set.
+    """
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(labels.astype(jnp.float32) * lp, axis=axis)
+        mask = None
+    else:
+        nclass = logits.shape[axis]
+        if label_smoothing > 0.0:
+            on = 1.0 - label_smoothing
+            off = label_smoothing / nclass
+            loss = -(on * jnp.take_along_axis(
+                lp, jnp.expand_dims(jnp.clip(labels, 0, nclass - 1), axis),
+                axis=axis).squeeze(axis) + off * jnp.sum(lp, axis=axis))
+        else:
+            loss = -jnp.take_along_axis(
+                lp, jnp.expand_dims(jnp.clip(labels, 0, nclass - 1), axis),
+                axis=axis).squeeze(axis)
+        mask = (labels != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if mask is not None:
+        denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return jnp.sum(loss) / denom
+    return jnp.mean(loss)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
+    return cross_entropy(logits, label, reduction="none",
+                         soft_label=soft_label, axis=axis,
+                         ignore_index=-100)
+
+
+def mse_loss(input, label, reduction: str = "mean"):
+    d = jnp.square(input.astype(jnp.float32) - label.astype(jnp.float32))
+    if reduction == "none":
+        return d
+    return jnp.sum(d) if reduction == "sum" else jnp.mean(d)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p: float = 0.0, is_causal: bool = False,
+                                 training: bool = True, scale=None):
+    """Attention over (batch, seq, heads, head_dim) tensors — the reference's
+    flash-attention layout (paddle/phi/kernels/gpu/flash_attn_kernel.cu,
+    upstream layout).  Routes to the Pallas flash kernel when eligible."""
+    from ..ops import flash_attention
+    out, _ = flash_attention(query, key, value, attn_mask=attn_mask,
+                             dropout_p=dropout_p if training else 0.0,
+                             causal=is_causal, scale=scale, return_lse=True)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# conv / pooling (NCHW default, matching the reference)
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCHW"):
+    """2D convolution.  ``weight`` layout (out_c, in_c/groups, kh, kw) — the
+    reference's conv kernel layout."""
+    x, weight, bias = _amp.cast_inputs("conv2d", x, weight, bias)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad_arg = padding.upper()
+    else:
+        p = _pair(padding)
+        pad_arg = [(p[0], p[0]), (p[1], p[1])]
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad_arg,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1, 1).astype(y.dtype)
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, k[0], k[1]), (1, 1, s[0], s[1]),
+        [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    ones = jnp.ones_like(x)
+    win = (1, 1, k[0], k[1])
+    str_ = (1, 1, s[0], s[1])
+    pad_ = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    num = lax.reduce_window(x, 0.0, lax.add, win, str_, pad_)
+    den = lax.reduce_window(ones, 0.0, lax.add, win, str_, pad_)
+    return num / den
+
+
+def pad(x, paddings, mode: str = "constant", value: float = 0.0):
+    """paddings: flat [lo_d0, hi_d0, lo_d1, hi_d1, ...] over the last dims,
+    matching ``paddle.nn.functional.pad``'s flat form, or per-dim pairs."""
+    if isinstance(paddings[0], (tuple, list)):
+        pairs = [tuple(p) for p in paddings]
+    else:
+        n = len(paddings) // 2
+        pairs = [(0, 0)] * (x.ndim - n) + [
+            (paddings[2 * i], paddings[2 * i + 1]) for i in range(n)]
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=value)
+    return jnp.pad(x, pairs, mode={"reflect": "reflect",
+                                   "replicate": "edge"}[mode])
+
+
+def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
+    """im2col (parity: F.unfold) — used by vision models."""
+    k = _pair(kernel_size)
+    s = _pair(stride)
+    p = _pair(padding)
+    d = _pair(dilation)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # (n, c*kh*kw, oh, ow) -> (n, c*kh*kw, oh*ow)
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                data_format: str = "NCHW"):
+    if data_format == "NCHW":
+        xs = jnp.moveaxis(x, 1, -1)
+    else:
+        xs = x
+    h, w = xs.shape[1:3]
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[mode]
+    y = jax.image.resize(xs, (xs.shape[0], size[0], size[1], xs.shape[-1]),
+                         method=method)
+    if data_format == "NCHW":
+        y = jnp.moveaxis(y, -1, 1)
+    return y.astype(x.dtype)

@@ -1,0 +1,141 @@
+"""Weight initializers.
+
+Parity with the reference's ``paddle.nn.initializer`` package (upstream
+layout: python/paddle/nn/initializer/ — constant, normal, uniform, xavier,
+kaiming, truncated normal).  Each initializer is a callable
+``(shape, dtype, key) -> jax.Array``; keys come from
+``paddle_tpu.framework.random``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign",
+]
+
+
+class Initializer:
+    def __call__(self, shape, dtype, key):
+        raise NotImplementedError
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # dense weights are (in_features, out_features) in this framework
+        return shape[0], shape[1]
+    # conv kernels are OIHW: (out_c, in_c/groups, *spatial)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype, key):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Assign(Initializer):
+    """Initialise from an existing array/list (parity: initializer.Assign)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype, key):
+        v = jnp.asarray(self.value, dtype=dtype)
+        if tuple(v.shape) != tuple(shape):
+            raise ValueError(f"Assign shape {v.shape} != requested {shape}")
+        return v
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype, key):
+        # sample in fp32 then cast: stable for bf16 params
+        x = jax.random.normal(key, shape, dtype=jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype, key):
+        x = jax.random.truncated_normal(key, self.a, self.b, shape,
+                                        dtype=jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype, key):
+        x = jax.random.uniform(key, shape, dtype=jnp.float32,
+                               minval=self.low, maxval=self.high)
+        return x.astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype, key):
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        x = jax.random.normal(key, shape, dtype=jnp.float32) * std
+        return x.astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype, key):
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        x = jax.random.uniform(key, shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="relu"):
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype, key):
+        fan_in, _ = _fans(shape)
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fan_in)
+        x = jax.random.normal(key, shape, dtype=jnp.float32) * std
+        return x.astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="relu"):
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype, key):
+        fan_in, _ = _fans(shape)
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fan_in)
+        x = jax.random.uniform(key, shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(dtype)

@@ -1,0 +1,107 @@
+"""Transformer building blocks.
+
+Parity with the reference's ``paddle.nn.MultiHeadAttention`` /
+``TransformerEncoderLayer`` (upstream layout: python/paddle/nn/layer/
+transformer.py) — but attention always routes through the flash-attention
+entry (paddle_tpu/ops/attention.py), the TPU equivalent of the reference's
+fused_attention CUDA kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import functional as F
+from .common import Dropout, LayerNorm, Linear
+from .layer import Layer
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
+           "TransformerEncoder", "FeedForward"]
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 kdim: Optional[int] = None, vdim: Optional[int] = None,
+                 bias: bool = True, dtype=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.q_proj = Linear(embed_dim, embed_dim, bias=bias, dtype=dtype)
+        self.k_proj = Linear(kdim or embed_dim, embed_dim, bias=bias, dtype=dtype)
+        self.v_proj = Linear(vdim or embed_dim, embed_dim, bias=bias, dtype=dtype)
+        self.out_proj = Linear(embed_dim, embed_dim, bias=bias, dtype=dtype)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                is_causal: bool = False):
+        key = query if key is None else key
+        value = key if value is None else value
+        b, sq, _ = query.shape
+        skv = key.shape[1]
+        q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
+        k = self.k_proj(key).reshape(b, skv, self.num_heads, self.head_dim)
+        v = self.v_proj(value).reshape(b, skv, self.num_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            is_causal=is_causal, training=self.training)
+        return self.out_proj(out.reshape(b, sq, self.embed_dim))
+
+
+class FeedForward(Layer):
+    def __init__(self, d_model: int, dim_feedforward: int,
+                 activation: str = "gelu", dropout: float = 0.0, dtype=None):
+        super().__init__()
+        self.fc1 = Linear(d_model, dim_feedforward, dtype=dtype)
+        self.fc2 = Linear(dim_feedforward, d_model, dtype=dtype)
+        self.drop = Dropout(dropout)
+        self.activation = activation
+
+    def forward(self, x):
+        act = {"relu": F.relu, "gelu": F.gelu, "silu": F.silu}[self.activation]
+        return self.fc2(self.drop(act(self.fc1(x))))
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "gelu",
+                 normalize_before: bool = True, dtype=None):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                            dtype=dtype)
+        self.ffn = FeedForward(d_model, dim_feedforward, activation, dropout,
+                               dtype=dtype)
+        self.norm1 = LayerNorm(d_model, dtype=dtype)
+        self.norm2 = LayerNorm(d_model, dtype=dtype)
+        self.drop1 = Dropout(dropout)
+        self.drop2 = Dropout(dropout)
+        self.normalize_before = normalize_before
+
+    def forward(self, x, attn_mask=None):
+        if self.normalize_before:
+            x = x + self.drop1(self.self_attn(self.norm1(x),
+                                              attn_mask=attn_mask))
+            x = x + self.drop2(self.ffn(self.norm2(x)))
+        else:
+            x = self.norm1(x + self.drop1(self.self_attn(x, attn_mask=attn_mask)))
+            x = self.norm2(x + self.drop2(self.ffn(x)))
+        return x
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers: int,
+                 norm: Optional[Layer] = None):
+        super().__init__()
+        from .layer import LayerList
+        self.layers = LayerList([encoder_layer_fn() for _ in range(num_layers)])
+        self.norm = norm
+
+    def forward(self, x, attn_mask=None):
+        for l in self.layers:
+            x = l(x, attn_mask=attn_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x

@@ -1,0 +1,20 @@
+"""Hot-path ops: the TPU-native equivalent of the reference's fused CUDA
+kernels (upstream layout: paddle/phi/kernels/fusion/gpu/ and
+paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+
+Every op has (a) a pure-XLA reference implementation — correct everywhere,
+used on CPU and as the numerical oracle — and (b) where it pays, a Pallas
+kernel for TPU (paddle_tpu/ops/pallas/).  Dispatch picks the Pallas path on
+TPU backends (or when FLAGS_pallas_interpret forces interpreter mode for
+testing).
+"""
+
+from .attention import flash_attention, flash_attention_reference
+from .norms import rms_norm, rms_norm_reference
+from .rope import apply_rope, build_rope_cache, fused_rope
+
+__all__ = [
+    "flash_attention", "flash_attention_reference",
+    "rms_norm", "rms_norm_reference",
+    "apply_rope", "build_rope_cache", "fused_rope",
+]

@@ -1,0 +1,30 @@
+"""Backend dispatch helpers for ops with Pallas fast paths."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from .. import flags
+
+
+@functools.cache
+def default_backend() -> str:
+    return jax.default_backend()
+
+
+def use_pallas() -> bool:
+    """True when the Pallas TPU path should be taken.
+
+    On TPU: always.  Elsewhere: only when FLAGS_pallas_interpret is set
+    (Pallas interpreter mode — used to test the kernels on CPU).
+    """
+    if flags.flag("pallas_interpret"):
+        return True
+    return default_backend() in ("tpu", "axon")
+
+
+def pallas_interpret() -> bool:
+    return bool(flags.flag("pallas_interpret")) or default_backend() not in (
+        "tpu", "axon")

@@ -1,0 +1,115 @@
+"""Flash attention: XLA reference implementation + dispatch to the Pallas
+TPU kernel.
+
+Equivalent of the reference's flash-attention integration (upstream layout:
+paddle/phi/kernels/gpu/flash_attn_kernel.cu, which wraps the external
+flashattn library and exposes ``softmax_lse`` — the log-sum-exp needed by
+ring attention).  Layout convention matches the reference:
+``(batch, seq, num_heads, head_dim)``; GQA is supported by passing fewer KV
+heads than Q heads.
+
+The reference implementation below is *mathematically* flash attention
+(numerically stable softmax, fp32 accumulation, returns LSE) but leaves the
+tiling to XLA; the Pallas kernel (paddle_tpu/ops/pallas/flash_attention.py)
+implements the blocked online-softmax algorithm for TPU HBM-bandwidth
+efficiency and is selected on TPU backends.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from . import _dispatch
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k, n_rep: int):
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :],
+                            (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def flash_attention_reference(q, k, v, attn_mask=None, dropout_p: float = 0.0,
+                              causal: bool = False, scale: Optional[float] = None,
+                              return_lse: bool = True):
+    """Stable attention with fp32 accumulation.
+
+    q: (B, Sq, Hq, D); k, v: (B, Skv, Hkv, D) with Hq % Hkv == 0.
+    attn_mask: bool (True = keep) or additive float mask, broadcastable to
+    (B, Hq, Sq, Skv).
+    Returns (out, lse) — lse: (B, Hq, Sq) fp32, log-sum-exp of scaled scores.
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if scale is None:
+        scale = d ** -0.5
+    k = _repeat_kv(k, hq // hkv)
+    v = _repeat_kv(v, hq // hkv)
+
+    # (B, H, Sq, Skv) scores in fp32
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        # bottom-right aligned causal mask (flash-attn convention for Sq<Skv)
+        qi = jnp.arange(sq)[:, None] + (skv - sq)
+        ki = jnp.arange(skv)[None, :]
+        scores = jnp.where(ki <= qi, scores, NEG_INF)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, NEG_INF)
+        else:
+            scores = scores + attn_mask.astype(jnp.float32)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # guard fully-masked rows
+    p = jnp.exp(scores - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-37))).squeeze(-1)  # (B, H, Sq)
+
+    p = p / jnp.maximum(l, 1e-37)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(_random.site_key(), 1.0 - dropout_p,
+                                    p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt,
+                     preferred_element_type=jnp.float32)
+    out = jnp.swapaxes(out, 1, 2).astype(q.dtype)  # (B, Sq, H, D)
+    if return_lse:
+        return out, lse
+    return out
+
+
+def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
+                    causal: bool = False, scale: Optional[float] = None,
+                    return_lse: bool = False):
+    """Public entry (parity: ``paddle.nn.functional.flash_attention``).
+
+    Dispatches to the Pallas blocked kernel on TPU when the shape/feature set
+    is eligible (no dropout, no custom mask — same restrictions as the
+    reference's flash path, which falls back to the math path otherwise).
+    """
+    eligible = (dropout_p == 0.0 and attn_mask is None
+                and q.shape[-1] <= 256)
+    if eligible and _dispatch.use_pallas():
+        try:
+            from .pallas.flash_attention import flash_attention_pallas
+            out, lse = flash_attention_pallas(
+                q, k, v, causal=causal, scale=scale,
+                interpret=_dispatch.pallas_interpret())
+            return (out, lse) if return_lse else out
+        except NotImplementedError:
+            pass
+    res = flash_attention_reference(q, k, v, attn_mask=attn_mask,
+                                    dropout_p=dropout_p, causal=causal,
+                                    scale=scale, return_lse=True)
+    return res if return_lse else res[0]

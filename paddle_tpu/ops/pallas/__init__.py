@@ -1,0 +1,2 @@
+"""Pallas TPU kernels — the framework's equivalent of the reference's
+hand-written CUDA kernels (paddle/phi/kernels/fusion/gpu/, upstream layout)."""

@@ -1,0 +1,9 @@
+"""Blocked flash-attention Pallas kernel (placeholder gate).
+
+The real kernel lands with the Llama milestone; until then dispatch falls
+back to the XLA reference implementation.
+"""
+
+
+def flash_attention_pallas(q, k, v, causal=False, scale=None, interpret=False):
+    raise NotImplementedError

@@ -1,0 +1,52 @@
+"""Rotary position embedding (RoPE).
+
+Equivalent of the reference's fused_rotary_position_embedding CUDA kernel
+(upstream layout: paddle/phi/kernels/fusion/gpu/fused_rope_*,
+paddle.incubate.nn.functional.fused_rotary_position_embedding).
+
+Convention: NeoX/Llama half-rotation — split head_dim in halves rather than
+interleaving pairs; inputs are (batch, seq, heads, head_dim).  cos/sin caches
+are fp32; rotation is computed in fp32 and cast back (bf16-safe).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def build_rope_cache(seq_len: int, head_dim: int, base: float = 10000.0,
+                     scaling_factor: float = 1.0,
+                     dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin caches of shape (seq_len, head_dim//2)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32) / scaling_factor
+    freqs = jnp.outer(t, inv_freq)  # (S, D/2)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin, position_ids: Optional[jnp.ndarray] = None):
+    """Rotate (B, S, H, D) by cos/sin caches (S_cache, D/2)."""
+    dt = x.dtype
+    if position_ids is not None:
+        cos = jnp.take(cos, position_ids, axis=0)  # (B, S, D/2)
+        sin = jnp.take(sin, position_ids, axis=0)
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        s = x.shape[1]
+        cos = cos[None, :s, None, :]
+        sin = sin[None, :s, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.concatenate([y1, y2], axis=-1).astype(dt)
+
+
+def fused_rope(q, k, cos, sin, position_ids=None):
+    """Apply RoPE to q and k (the reference's fused_rope signature shape)."""
+    return (apply_rope(q, cos, sin, position_ids),
+            apply_rope(k, cos, sin, position_ids))

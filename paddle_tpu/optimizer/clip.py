@@ -1,0 +1,68 @@
+"""Gradient clipping.
+
+Parity with the reference's clip classes (upstream layout:
+python/paddle/nn/clip.py — ``ClipGradByGlobalNorm``, ``ClipGradByNorm``,
+``ClipGradByValue``).  Each is a callable ``grads_tree -> grads_tree``.
+
+``ClipGradByGlobalNorm`` optionally reduces the squared norm over mesh axes
+(``psum_axes``) — the TPU-native version of the reference's hybrid-parallel
+global-norm allreduce across mp/pp/sharding groups
+(fleet/utils/hybrid_parallel_util.py + dygraph_sharding_optimizer, upstream
+layout): inside ``shard_map`` the partial sum rides ICI via ``lax.psum``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+           "global_norm"]
+
+
+def global_norm(grads, psum_axes: Optional[Sequence[str]] = None):
+    leaves = jax.tree_util.tree_leaves(grads)
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    if psum_axes:
+        sq = lax.psum(sq, tuple(psum_axes))
+    return jnp.sqrt(sq)
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm: float,
+                 psum_axes: Optional[Sequence[str]] = None):
+        self.clip_norm = float(clip_norm)
+        self.psum_axes = psum_axes
+
+    def __call__(self, grads):
+        norm = global_norm(grads, self.psum_axes)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+class ClipGradByNorm:
+    """Per-tensor L2 clip."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            s = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g.astype(jnp.float32) * s).astype(g.dtype)
+        return jax.tree_util.tree_map(clip, grads)
+
+
+class ClipGradByValue:
+    def __init__(self, max_value: float, min_value: Optional[float] = None):
+        self.max = float(max_value)
+        self.min = float(min_value) if min_value is not None else -self.max
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)

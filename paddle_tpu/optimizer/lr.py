@@ -1,0 +1,153 @@
+"""Learning-rate schedulers.
+
+Parity with the reference's ``paddle.optimizer.lr`` (upstream layout:
+python/paddle/optimizer/lr.py).  Schedulers are *pure functions of the step
+counter* — ``value(step)`` is built from jnp ops so it can live inside a
+jit-compiled training step (the step counter is a traced int32 array in the
+optimizer state), unlike the reference's Python-side ``LRScheduler.step()``.
+An imperative ``step()/get_lr()`` mirror is kept for API parity.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["LRScheduler", "ConstantLR", "LinearWarmup", "CosineAnnealingDecay",
+           "StepDecay", "MultiStepDecay", "ExponentialDecay", "NoamDecay",
+           "PolynomialDecay"]
+
+
+class LRScheduler:
+    def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1):
+        self.base_lr = float(learning_rate)
+        self.last_epoch = last_epoch
+        self.step()  # initialise to epoch 0 like the reference
+
+    # -- pure form (used inside jit) ---------------------------------------
+    def value(self, step):
+        """lr at integer/array ``step`` — override in subclasses."""
+        raise NotImplementedError
+
+    # -- imperative mirror --------------------------------------------------
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+
+    def get_lr(self):
+        return float(self.value(jnp.asarray(self.last_epoch, jnp.float32)))
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+
+
+class ConstantLR(LRScheduler):
+    def value(self, step):
+        return jnp.full((), self.base_lr, jnp.float32)
+
+
+class LinearWarmup(LRScheduler):
+    """Linear warmup into an inner scheduler (or a constant)."""
+
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float = 0.0,
+                 end_lr: float = None, last_epoch: int = -1):
+        self.inner = learning_rate if isinstance(learning_rate, LRScheduler) \
+            else None
+        base = learning_rate.base_lr if self.inner else float(learning_rate)
+        self.warmup_steps = int(warmup_steps)
+        self.start_lr = float(start_lr)
+        self.end_lr = float(end_lr) if end_lr is not None else base
+        super().__init__(base, last_epoch)
+
+    def value(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / max(self.warmup_steps, 1), 0.0, 1.0)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * frac
+        if self.inner is not None:
+            after = self.inner.value(jnp.maximum(step - self.warmup_steps, 0))
+            return jnp.where(step < self.warmup_steps, warm, after)
+        return jnp.where(step < self.warmup_steps, warm,
+                         jnp.full((), self.end_lr, jnp.float32))
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate: float, T_max: int, eta_min: float = 0.0,
+                 last_epoch: int = -1):
+        self.T_max = int(T_max)
+        self.eta_min = float(eta_min)
+        super().__init__(learning_rate, last_epoch)
+
+    def value(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        t = jnp.clip(step / self.T_max, 0.0, 1.0)
+        return self.eta_min + 0.5 * (self.base_lr - self.eta_min) * (
+            1.0 + jnp.cos(math.pi * t))
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, step_size: int, gamma: float = 0.1,
+                 last_epoch: int = -1):
+        self.step_size = int(step_size)
+        self.gamma = float(gamma)
+        super().__init__(learning_rate, last_epoch)
+
+    def value(self, step):
+        k = jnp.floor(jnp.asarray(step, jnp.float32) / self.step_size)
+        return self.base_lr * jnp.power(self.gamma, k)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, milestones, gamma: float = 0.1,
+                 last_epoch: int = -1):
+        self.milestones = [int(m) for m in milestones]
+        self.gamma = float(gamma)
+        super().__init__(learning_rate, last_epoch)
+
+    def value(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        k = jnp.zeros((), jnp.float32)
+        for m in self.milestones:
+            k = k + (step >= m).astype(jnp.float32)
+        return self.base_lr * jnp.power(self.gamma, k)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1):
+        self.gamma = float(gamma)
+        super().__init__(learning_rate, last_epoch)
+
+    def value(self, step):
+        return self.base_lr * jnp.power(self.gamma,
+                                        jnp.asarray(step, jnp.float32))
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model: int, warmup_steps: int,
+                 learning_rate: float = 1.0, last_epoch: int = -1):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch)
+
+    def value(self, step):
+        s = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(
+            s ** -0.5, s * (self.warmup_steps ** -1.5))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 end_lr: float = 0.0001, power: float = 1.0,
+                 last_epoch: int = -1):
+        self.decay_steps = int(decay_steps)
+        self.end_lr = float(end_lr)
+        self.power = float(power)
+        super().__init__(learning_rate, last_epoch)
+
+    def value(self, step):
+        t = jnp.clip(jnp.asarray(step, jnp.float32) / self.decay_steps, 0.0, 1.0)
+        return (self.base_lr - self.end_lr) * jnp.power(1.0 - t,
+                                                        self.power) + self.end_lr

@@ -1,0 +1,38 @@
+"""Test configuration: force an 8-device virtual CPU backend.
+
+Mirrors the reference's CI practice of faking multi-device with
+multi-process-on-one-host (SURVEY.md §4): here jax's
+``xla_force_host_platform_device_count`` provides 8 CPU devices so every
+mesh/sharding/collective test runs without TPU hardware.  Must run before
+any jax backend initialisation — pytest imports conftest first.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+# the axon site-customisation pins JAX_PLATFORMS=axon (the real TPU tunnel);
+# jax.config wins over the env var, so set it through the config API.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_seed():
+    import paddle_tpu as pt
+
+    pt.seed(0)
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()).reshape(2, 2, 2)
+    with Mesh(devs, ("dp", "fsdp", "tp")) as m:
+        yield m

@@ -1,0 +1,103 @@
+"""AMP tests: autocast policy, O2 decorate, GradScaler dynamics.
+Pattern: test/amp/ (upstream layout)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import amp, nn
+
+
+def test_autocast_casts_whitelist():
+    x = jnp.ones((2, 4), jnp.float32)
+    w = jnp.ones((4, 3), jnp.float32)
+    with amp.auto_cast(dtype="bfloat16"):
+        y = nn.functional.linear(x, w)
+    assert y.dtype == jnp.bfloat16
+    y2 = nn.functional.linear(x, w)
+    assert y2.dtype == jnp.float32
+
+
+def test_autocast_blacklist_untouched():
+    x = jnp.ones((2, 8), jnp.float32)
+    with amp.auto_cast(dtype="bfloat16"):
+        y = nn.functional.softmax(x)
+    assert y.dtype == jnp.float32
+
+
+def test_autocast_custom_lists():
+    x = jnp.ones((2, 4), jnp.float32)
+    w = jnp.ones((4, 3), jnp.float32)
+    with amp.auto_cast(dtype="bfloat16", custom_black_list={"linear"}):
+        y = nn.functional.linear(x, w)
+    assert y.dtype == jnp.float32
+
+
+def test_decorate_o2():
+    m = nn.Linear(4, 4)
+    m2 = amp.decorate(m, level="O2", dtype="bfloat16")
+    assert m2.weight.dtype == jnp.bfloat16
+
+
+def test_grad_scaler_scale_unscale():
+    s = amp.GradScaler(init_loss_scaling=1024.0)
+    loss = jnp.asarray(2.0)
+    assert float(s.scale(loss)) == 2048.0
+    grads = {"w": jnp.asarray([1024.0, 2048.0])}
+    un = s.unscale_(grads)
+    np.testing.assert_allclose(np.asarray(un["w"]), [1.0, 2.0])
+    assert not bool(s._found_inf)
+
+
+def test_grad_scaler_inf_detection_and_decay():
+    s = amp.GradScaler(init_loss_scaling=1024.0, decr_ratio=0.5,
+                       decr_every_n_nan_or_inf=1)
+    grads = {"w": jnp.asarray([jnp.inf])}
+    s.unscale_(grads)
+    assert bool(s._found_inf)
+    s.update()
+    assert float(s.loss_scaling) == 512.0
+
+
+def test_grad_scaler_growth():
+    s = amp.GradScaler(init_loss_scaling=2.0, incr_every_n_steps=2,
+                       incr_ratio=2.0)
+    g = {"w": jnp.asarray([1.0])}
+    for _ in range(2):
+        s.unscale_(g)
+        s.update()
+    assert float(s.loss_scaling) == 4.0
+
+
+def test_grad_scaler_functional_skip():
+    """found_inf must gate the param update in functional use."""
+    s = amp.GradScaler(init_loss_scaling=1.0)
+    st = s.init_state()
+    grads = {"w": jnp.asarray([jnp.nan])}
+    _, found = s.unscale_with(st, grads)
+    assert bool(found)
+
+
+def test_grad_scaler_step_unscales_internally():
+    """Regression: scaler.step() without explicit unscale_ must unscale."""
+    from paddle_tpu import optimizer as opt
+    model = nn.Linear(2, 2, bias=False)
+    o = opt.SGD(learning_rate=1.0, parameters=model)
+    s = amp.GradScaler(init_loss_scaling=1024.0)
+    w0 = np.asarray(model.weight).copy()
+    scaled_grads = {"weight": jnp.full((2, 2), 1024.0)}  # true grad = 1.0
+    s.step(o, scaled_grads)
+    s.update()
+    np.testing.assert_allclose(np.asarray(model.weight), w0 - 1.0, rtol=1e-6)
+
+
+def test_grad_scaler_step_skips_on_inf():
+    from paddle_tpu import optimizer as opt
+    model = nn.Linear(2, 2, bias=False)
+    o = opt.SGD(learning_rate=1.0, parameters=model)
+    s = amp.GradScaler(init_loss_scaling=2.0)
+    w0 = np.asarray(model.weight).copy()
+    s.step(o, {"weight": jnp.full((2, 2), jnp.inf)})
+    s.update()
+    np.testing.assert_allclose(np.asarray(model.weight), w0)
+    assert float(s.loss_scaling) == 1.0  # halved

@@ -1,0 +1,99 @@
+"""Flash-attention tests: NumPy oracle, LSE correctness, causal/GQA, grads.
+Pattern: reference's flash_attn op tests (test/legacy_test/test_flash_attention.py,
+upstream layout)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import flash_attention, flash_attention_reference
+
+
+def np_attention(q, k, v, causal=False, scale=None):
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if scale is None:
+        scale = d ** -0.5
+    rep = hq // hkv
+    k = np.repeat(k, rep, axis=2)
+    v = np.repeat(v, rep, axis=2)
+    qt = q.transpose(0, 2, 1, 3).astype(np.float64) * scale
+    kt = k.transpose(0, 2, 1, 3).astype(np.float64)
+    s = qt @ kt.transpose(0, 1, 3, 2)
+    if causal:
+        qi = np.arange(sq)[:, None] + (skv - sq)
+        ki = np.arange(skv)[None, :]
+        s = np.where(ki <= qi, s, -np.inf)
+    m = s.max(-1, keepdims=True)
+    p = np.exp(s - m)
+    l = p.sum(-1, keepdims=True)
+    lse = (m + np.log(l)).squeeze(-1)
+    out = (p / l) @ v.transpose(0, 2, 1, 3).astype(np.float64)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _rand(shape, seed):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_attention_oracle():
+    q, k, v = (_rand((2, 8, 4, 16), i) for i in range(3))
+    out, lse = flash_attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), return_lse=True)
+    want, want_lse = np_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), want_lse, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_causal():
+    q, k, v = (_rand((1, 6, 2, 8), i + 10) for i in range(3))
+    out, lse = flash_attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), causal=True,
+                                         return_lse=True)
+    want, want_lse = np_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), want_lse, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_gqa():
+    q = _rand((2, 5, 8, 16), 20)
+    k = _rand((2, 5, 2, 16), 21)
+    v = _rand((2, 5, 2, 16), 22)
+    out = flash_attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), return_lse=False)
+    want, _ = np_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_bool_mask():
+    q, k, v = (_rand((1, 4, 1, 8), i + 30) for i in range(3))
+    mask = np.ones((1, 1, 4, 4), bool)
+    mask[..., -1] = False  # nobody attends to last key
+    out = flash_attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v),
+                                    attn_mask=jnp.asarray(mask),
+                                    return_lse=False)
+    want, _ = np_attention(q, k[:, :3], v[:, :3])
+    # masking last key == attending over first 3 only
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-4)
+
+
+def test_attention_grad_finite():
+    q, k, v = (jnp.asarray(_rand((1, 8, 2, 16), i + 40)) for i in range(3))
+
+    def loss(q, k, v):
+        out = flash_attention_reference(q, k, v, causal=True,
+                                        return_lse=False)
+        return jnp.sum(out ** 2)
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (gq, gk, gv):
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_dispatcher_matches_reference():
+    q, k, v = (jnp.asarray(_rand((1, 8, 2, 16), i + 50)) for i in range(3))
+    a = flash_attention(q, k, v, causal=True)
+    b = flash_attention_reference(q, k, v, causal=True, return_lse=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                               atol=1e-5)

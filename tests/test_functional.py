@@ -1,0 +1,180 @@
+"""Functional-op tests against NumPy oracles — the reference's OpTest pattern
+(test/legacy_test/op_test.py, upstream layout): forward vs a NumPy reference
+implementation + gradient vs numeric finite differences."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn import functional as F
+from paddle_tpu import ops
+
+RTOL = 1e-5
+
+
+def numeric_grad(f, x, eps=1e-4):
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_linear_oracle():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    w = rng.normal(size=(4, 5)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    got = np.asarray(F.linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+    np.testing.assert_allclose(got, x @ w + b, rtol=RTOL)
+
+
+def test_linear_grad_check():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 3)).astype(np.float32)
+    w = rng.normal(size=(3, 4)).astype(np.float32)
+
+    def loss_np(wv):
+        return float((x.astype(np.float64) @ wv).sum())
+
+    g = jax.grad(lambda wv: F.linear(jnp.asarray(x), wv).sum())(jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(g), numeric_grad(loss_np, w),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_layer_norm_oracle():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    w = rng.normal(size=(8,)).astype(np.float32)
+    b = rng.normal(size=(8,)).astype(np.float32)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * w + b
+    got = np.asarray(F.layer_norm(jnp.asarray(x), (8,), jnp.asarray(w),
+                                  jnp.asarray(b)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_oracle_and_grad():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    w = rng.normal(size=(8,)).astype(np.float32)
+    want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+    got = np.asarray(ops.rms_norm(jnp.asarray(x), jnp.asarray(w)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def loss_np(xv):
+        xv = xv.astype(np.float64)
+        return float((xv / np.sqrt((xv ** 2).mean(-1, keepdims=True) + 1e-6)
+                      * w).sum())
+
+    g = jax.grad(lambda xv: ops.rms_norm(xv, jnp.asarray(w)).sum())(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), numeric_grad(loss_np, x),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_softmax_cross_entropy_oracle():
+    rng = np.random.default_rng(4)
+    logits = rng.normal(size=(6, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(6,))
+    # numpy oracle
+    z = logits - logits.max(-1, keepdims=True)
+    lp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    want = -lp[np.arange(6), labels]
+    got = np.asarray(F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                                     reduction="none"))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.zeros((4, 3))
+    labels = jnp.asarray([0, 1, -100, 2])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    np.testing.assert_allclose(float(loss), np.log(3.0), rtol=1e-5)
+
+
+def test_cross_entropy_label_smoothing():
+    rng = np.random.default_rng(5)
+    logits = rng.normal(size=(5, 7)).astype(np.float32)
+    labels = rng.integers(0, 7, size=(5,))
+    eps = 0.1
+    z = logits - logits.max(-1, keepdims=True)
+    lp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    want = -((1 - eps) * lp[np.arange(5), labels] + eps / 7 * lp.sum(-1))
+    got = np.asarray(F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                                     reduction="none", label_smoothing=eps))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_activations_oracle():
+    x = np.linspace(-3, 3, 31).astype(np.float32)
+    jx = jnp.asarray(x)
+    np.testing.assert_allclose(np.asarray(F.relu(jx)), np.maximum(x, 0))
+    np.testing.assert_allclose(np.asarray(F.silu(jx)),
+                               x / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.softplus(jx)), np.log1p(np.exp(x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.swiglu(jx[:10], jx[10:20])),
+        (x[:10] / (1 + np.exp(-x[:10]))) * x[10:20], rtol=1e-5)
+
+
+def test_conv2d_oracle_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(4,)).astype(np.float32)
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+        stride=2, padding=1).numpy()
+    got = np.asarray(F.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                              stride=2, padding=1))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_statistics_and_determinism():
+    pt.seed(7)
+    x = jnp.ones((10000,))
+    y = F.dropout(x, 0.3, training=True)
+    keep = float((np.asarray(y) > 0).mean())
+    assert abs(keep - 0.7) < 0.03
+    # same seed + rng_guard => deterministic
+    from paddle_tpu.framework import random as R
+    k = jax.random.key(42)
+    with R.rng_guard(k):
+        a = np.asarray(F.dropout(x, 0.3))
+    with R.rng_guard(k):
+        b = np.asarray(F.dropout(x, 0.3))
+    np.testing.assert_allclose(a, b)
+
+
+def test_rope_rotation_properties():
+    cos, sin = ops.build_rope_cache(16, 8)
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.normal(size=(1, 16, 2, 8)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 16, 2, 8)).astype(np.float32))
+    qr, kr = ops.fused_rope(q, k, cos, sin)
+    # norm preserved (rotation)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(qr), axis=-1),
+                               np.linalg.norm(np.asarray(q), axis=-1),
+                               rtol=1e-4)
+    # position 0 unrotated
+    np.testing.assert_allclose(np.asarray(qr[:, 0]), np.asarray(q[:, 0]),
+                               rtol=1e-5)
+    # relative-position property: <rot(q,m), rot(k,n)> depends only on m-n
+    d1 = float(jnp.sum(qr[0, 5, 0] * kr[0, 3, 0]))
+    q2, k2 = ops.fused_rope(q, k, cos, sin,
+                            position_ids=jnp.broadcast_to(
+                                jnp.arange(16) + 0, (1, 16)))
+    d2 = float(jnp.sum(q2[0, 5, 0] * k2[0, 3, 0]))
+    np.testing.assert_allclose(d1, d2, rtol=1e-5)

@@ -1,0 +1,152 @@
+"""Layer-system tests: registration, traversal, state_dict, functional bridge.
+
+Modeled on the reference's layer tests (test/legacy_test/test_base_layer.py,
+upstream layout).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_parameter_registration():
+    m = MLP()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert isinstance(m.fc1.weight, jax.Array)
+    assert m.fc1.weight.shape == (4, 8)
+
+
+def test_forward_eager():
+    m = MLP()
+    y = m(jnp.ones((3, 4)))
+    assert y.shape == (3, 2)
+
+
+def test_state_dict_roundtrip():
+    m1, m2 = MLP(), MLP()
+    sd = m1.state_dict()
+    assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    m2.set_state_dict(sd)
+    x = jnp.ones((3, 4))
+    np.testing.assert_allclose(np.asarray(m1(x)), np.asarray(m2(x)))
+
+
+def test_state_dict_shape_check():
+    m = MLP()
+    with pytest.raises(ValueError):
+        m.set_state_dict({"fc1.weight": jnp.zeros((5, 5))}, strict=False)
+
+
+def test_functional_call_is_pure():
+    m = MLP()
+    params = m.trainable_state()
+    before = np.asarray(m.fc1.weight).copy()
+    zeroed = {k: jnp.zeros_like(v) for k, v in params.items()}
+    y = nn.functional_call(m, zeroed, jnp.ones((3, 4)))
+    np.testing.assert_allclose(np.asarray(y), 0.0)
+    # live module untouched
+    np.testing.assert_allclose(np.asarray(m.fc1.weight), before)
+
+
+def test_functional_call_jit_grad():
+    m = MLP()
+    params = m.trainable_state()
+    x = jnp.ones((3, 4))
+    t = jnp.zeros((3,), jnp.int32)
+
+    def loss_fn(p):
+        logits = nn.functional_call(m, p, x)
+        return nn.functional.cross_entropy(logits, t)
+
+    g = jax.jit(jax.grad(loss_fn))(params)
+    assert set(g) == set(params)
+    assert all(np.all(np.isfinite(np.asarray(v))) for v in g.values())
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert s(jnp.ones((1, 4))).shape == (1, 2)
+    assert len(s) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert ll[-1] is ll[2]
+    assert len(list(ll)) == 3
+
+
+def test_train_eval_mode_dropout():
+    m = nn.Sequential(nn.Dropout(0.5))
+    m.eval()
+    x = jnp.ones((100,))
+    np.testing.assert_allclose(np.asarray(m(x)), 1.0)
+    m.train()
+    y = np.asarray(m(x))
+    assert (y == 0).any() and (y > 1).any()
+
+
+def test_astype_casts_floats_only():
+    m = MLP()
+    m.register_buffer("counter", jnp.zeros((), jnp.int32))
+    m.astype("bfloat16")
+    assert m.fc1.weight.dtype == jnp.bfloat16
+    assert m.counter.dtype == jnp.int32
+
+
+def test_buffers_in_state_dict():
+    m = MLP()
+    m.register_buffer("scale", jnp.ones((2,)))
+    assert "scale" in m.state_dict()
+    assert "scale" not in m.trainable_state()
+
+
+def test_trainable_flag():
+    m = MLP()
+    dict(m.named_parameters())["fc1.weight"].trainable = False
+    assert "fc1.weight" not in m.trainable_state()
+    assert "fc1.weight" in m.state_dict()
+
+
+def test_param_shardings_collected():
+    from jax.sharding import PartitionSpec as P
+
+    l = nn.Linear(4, 8, weight_sharding=P(None, "tp"))
+    specs = l.param_shardings()
+    assert specs["weight"] == P(None, "tp")
+    assert specs["bias"] is None
+
+
+def test_sequential_named_single_pair():
+    """Regression: a single (name, layer) tuple keeps its name."""
+    s = nn.Sequential(("fc", nn.Linear(4, 2)))
+    assert "fc.weight" in s.state_dict()
+
+
+def test_embedding_negative_padding_idx():
+    e = nn.Embedding(10, 4, padding_idx=-1)
+    out = e(jnp.asarray([9, 0]))
+    np.testing.assert_allclose(np.asarray(out[0]), 0.0)
+    assert np.abs(np.asarray(out[1])).sum() > 0
+
+
+def test_conv_fan_in_init_scale():
+    """Regression: Kaiming fan_in for OIHW conv weights = in_c*kh*kw."""
+    pt.seed(0)
+    c = nn.Conv2D(3, 64, 3, bias=False)
+    w = np.asarray(c.weight)
+    # KaimingUniform: limit = sqrt(2/(1+0))*sqrt(3/27) ≈ 0.471
+    assert 0.2 < np.abs(w).max() < 0.5

@@ -1,0 +1,66 @@
+"""RNG discipline tests: global seed, guards, parallel tracker."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework import random as R
+
+
+def test_seed_reproducible():
+    pt.seed(123)
+    a = R.next_key()
+    pt.seed(123)
+    b = R.next_key()
+    assert jnp.array_equal(jax.random.key_data(a), jax.random.key_data(b))
+
+
+def test_site_keys_distinct_within_guard():
+    with R.rng_guard(jax.random.key(0)):
+        k1, k2 = R.site_key(), R.site_key()
+    assert not jnp.array_equal(jax.random.key_data(k1),
+                               jax.random.key_data(k2))
+
+
+def test_guard_nesting_restores():
+    with R.rng_guard(jax.random.key(1)):
+        with R.rng_guard(jax.random.key(2)):
+            pass
+        assert R.in_rng_guard()
+    assert not R.in_rng_guard()
+
+
+def test_tracker_streams_differ():
+    t = R.RNGStatesTracker()
+    t.add("model_parallel_rng", 100)
+    t.add("global_rng", 200)
+    with R.rng_guard(jax.random.key(0)):
+        with t.rng_state("model_parallel_rng"):
+            a = R.site_key()
+        with t.rng_state("global_rng"):
+            b = R.site_key()
+    assert not jnp.array_equal(jax.random.key_data(a),
+                               jax.random.key_data(b))
+
+
+def test_tracker_axis_folding_in_shard_map(mesh8):
+    """Inside shard_map, the tracker folds the mesh position in → different
+    dropout masks per tp shard (the reference's per-rank dropout seeds)."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    t = R.RNGStatesTracker()
+    t.add("mp", 7)
+
+    def f(x):
+        with R.rng_guard(jax.random.key(0)):
+            with t.rng_state("mp", axis_name="tp"):
+                k = R.site_key()
+        return jax.random.uniform(k, x.shape)
+
+    x = jnp.zeros((8, 16))
+    out = shard_map(f, mesh=mesh8, in_specs=P("tp"), out_specs=P("tp"))(x)
+    # shards must differ from each other
+    a, b = np.asarray(out[:4]), np.asarray(out[4:])
+    assert not np.allclose(a, b)
