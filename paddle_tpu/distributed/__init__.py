@@ -1,0 +1,31 @@
+"""paddle_tpu.distributed — the distributed stack.
+
+TPU-native re-design of the reference's distributed packages
+(upstream layout: python/paddle/distributed/ + the C++ collective layer at
+paddle/fluid/distributed/collective/).  One ``jax.sharding.Mesh`` with named
+axes replaces the reference's 5D process topology + per-group NCCL
+communicators; XLA collectives over ICI/DCN replace ProcessGroupNCCL;
+``jax.distributed.initialize`` replaces TCPStore rendezvous.
+"""
+
+from .collective import (AxisGroup, ReduceOp, all_gather, all_reduce,
+                         all_to_all, axis_index, barrier, broadcast, pmax,
+                         pmean, pmin, ppermute, psum, recv_prev,
+                         reduce_scatter, send_next)
+from .env import (ParallelEnv, get_rank, get_world_size, hybrid_group,
+                  init_parallel_env, is_initialized, set_hybrid_group)
+from .topology import (AXIS_ORDER, CommunicateTopology,
+                       HybridCommunicateGroup, ParallelMode)
+
+__all__ = [
+    # topology
+    "AXIS_ORDER", "CommunicateTopology", "HybridCommunicateGroup",
+    "ParallelMode",
+    # env
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "hybrid_group", "set_hybrid_group", "ParallelEnv",
+    # collectives
+    "AxisGroup", "ReduceOp", "all_reduce", "all_gather", "reduce_scatter",
+    "all_to_all", "broadcast", "ppermute", "send_next", "recv_prev",
+    "axis_index", "barrier", "psum", "pmean", "pmax", "pmin",
+]

@@ -1,0 +1,182 @@
+"""Tests for the distributed core: topology math + collectives.
+
+Mirrors the reference's test strategy (SURVEY.md §4): metadata logic tested
+device-free; collectives tested on the 8-device fake CPU backend against
+NumPy oracles.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.topology import CommunicateTopology
+
+
+# -- CommunicateTopology: pure coordinate math (no devices) ------------------
+
+def test_topology_rank_coord_roundtrip():
+    topo = CommunicateTopology(["pp", "dp", "mp"], [2, 3, 4])
+    assert topo.world_size() == 24
+    for rank in range(24):
+        coords = topo.get_coord(rank)
+        assert topo.get_rank(**coords) == rank
+
+
+def test_topology_strides_row_major():
+    # innermost axis (mp) is contiguous in rank order — TP peers are
+    # neighbouring devices (ICI), the design invariant of AXIS_ORDER
+    topo = CommunicateTopology(["dp", "mp"], [2, 4])
+    assert topo.get_axis_list("mp", 0) == [0, 4]
+    assert topo.get_comm_list("mp") == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert topo.get_comm_list("dp") == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+
+def test_topology_axis_aliases():
+    topo = CommunicateTopology(["dp", "mp"], [2, 4])
+    assert topo.get_dim("tp") == 4
+    assert topo.get_dim("model") == 4
+    assert topo.get_dim("data") == 2
+
+
+# -- HybridCommunicateGroup over real (fake-CPU) devices ---------------------
+
+def test_hcg_builds_mesh():
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, mp_degree=2,
+                                      sharding_degree=2)
+    assert hcg.mesh.shape == {"pp": 1, "dp": 2, "sharding": 2, "sep": 1,
+                              "mp": 2}
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_data_parallel_world_size() == 2
+    g = hcg.get_model_parallel_group()
+    assert g.axes == ("mp",) and g.nranks == 2
+
+
+def test_hcg_degree_mismatch_raises():
+    with pytest.raises(ValueError):
+        dist.HybridCommunicateGroup(dp_degree=3, mp_degree=2)
+
+
+def test_init_parallel_env_infers_dp():
+    hcg = dist.init_parallel_env(mp_degree=2)
+    try:
+        assert hcg.get_data_parallel_world_size() == 4
+        assert dist.is_initialized()
+    finally:
+        dist.set_hybrid_group(None)
+
+
+# -- collectives: traced mode (inside shard_map) vs numpy oracle -------------
+
+@pytest.fixture
+def hcg8():
+    hcg = dist.init_parallel_env(dp_degree=2, mp_degree=4)
+    yield hcg
+    dist.set_hybrid_group(None)
+
+
+def test_all_reduce_traced(hcg8):
+    x = jnp.arange(8.0)
+
+    def f(v):
+        return dist.all_reduce(v, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P())(x)
+    # 4 mp shards of size 2: psum over mp of each position pair
+    ref = x.reshape(4, 2).sum(0)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_all_gather_traced(hcg8):
+    x = jnp.arange(8.0)
+
+    def f(v):
+        return dist.all_gather(v, axis=0, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P(), check_vma=False)(x)
+    np.testing.assert_allclose(out, x)  # gather of shards == original
+
+
+def test_reduce_scatter_traced(hcg8):
+    x = jnp.ones((8, 4))
+
+    def f(v):
+        return dist.reduce_scatter(v, axis=0, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P(),
+                        out_specs=P("mp", None))(x)
+    # each mp rank holds the full (8,4); psum_scatter sums the 4 replicas and
+    # hands each rank a (2,4) row block → global (8,4) of 4.0
+    assert out.shape == (8, 4)
+    np.testing.assert_allclose(np.asarray(out), 4.0 * np.ones((8, 4)))
+
+
+def test_all_to_all_traced(hcg8):
+    # transpose a (ranks, k) layout: rank i holds a (4,2) row block, splits it
+    # 4-ways and concatenates what it receives along dim 1
+    x = jnp.arange(32.0).reshape(16, 2)
+
+    def f(v):
+        return dist.all_to_all(v, split_axis=0, concat_axis=1, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P("mp", None))(x)
+    assert out.shape == (4, 8)
+    # rank r's output row block = [block r of rank 0 | block r of rank 1 |...]
+    ref = np.asarray(x).reshape(4, 4, 1, 2).transpose(1, 0, 2, 3).reshape(4, 8)
+    np.testing.assert_allclose(np.asarray(out), ref)
+
+
+def test_broadcast_traced(hcg8):
+    x = jnp.arange(4.0)  # shard i holds value i
+
+    def f(v):
+        return dist.broadcast(v, src=2, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P("mp"))(x.reshape(4, 1))
+    np.testing.assert_allclose(np.asarray(out).ravel(), [2.0] * 4)
+
+
+def test_send_next_recv_prev(hcg8):
+    x = jnp.arange(4.0).reshape(4, 1)
+
+    def fwd(v):
+        return dist.send_next(v, group="mp")
+
+    out = jax.shard_map(fwd, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P("mp"))(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), [3, 0, 1, 2])
+
+    def bwd(v):
+        return dist.recv_prev(v, group="mp")
+
+    out = jax.shard_map(bwd, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P("mp"))(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), [1, 2, 3, 0])
+
+
+def test_axis_index_multi_axis(hcg8):
+    def f(v):
+        idx = dist.axis_index(dist.AxisGroup(("dp", "mp")))
+        return v + idx.astype(jnp.float32)
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P(("dp", "mp")),
+                        out_specs=P(("dp", "mp")))(jnp.zeros((8, 1)))
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.arange(8.0))
+
+
+# -- collectives: eager mode on global arrays --------------------------------
+
+def test_all_reduce_eager(hcg8):
+    x = jnp.arange(8.0)
+    out = dist.all_reduce(x, group=dist.AxisGroup("mp", hcg8.mesh))
+    np.testing.assert_allclose(out, x.reshape(4, 2).sum(0))
+
+
+def test_barrier_eager(hcg8):
+    dist.barrier(group=dist.AxisGroup("mp", hcg8.mesh))  # must not hang
