@@ -12,7 +12,7 @@ analysis), designed jax/XLA/Pallas/pjit-first rather than ported:
   * ProcessGroupNCCL/TCPStore ≙ jax.distributed + XLA collectives over ICI/DCN
 """
 
-from . import amp, flags, framework, nn, optimizer
+from . import amp, distributed, flags, framework, nn, optimizer
 from .framework import (device_count, get_default_dtype, is_compiled_with_tpu,
                         seed, set_default_dtype, to_tensor)
 from .flags import get_flags, set_flags
@@ -20,7 +20,7 @@ from .flags import get_flags, set_flags
 __version__ = "0.1.0"
 
 __all__ = [
-    "amp", "flags", "framework", "nn", "optimizer",
+    "amp", "distributed", "flags", "framework", "nn", "optimizer",
     "seed", "to_tensor", "device_count", "is_compiled_with_tpu",
     "get_default_dtype", "set_default_dtype", "get_flags", "set_flags",
     "__version__",

@@ -8,6 +8,10 @@ communicators; XLA collectives over ICI/DCN replace ProcessGroupNCCL;
 ``jax.distributed.initialize`` replaces TCPStore rendezvous.
 """
 
+from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate,
+                            Shard, dtensor_from_fn, get_placements,
+                            placements_to_spec, reshard, shard_layer,
+                            shard_tensor, spec_to_placements)
 from .collective import (AxisGroup, ReduceOp, all_gather, all_reduce,
                          all_to_all, axis_index, barrier, broadcast, pmax,
                          pmean, pmin, ppermute, psum, recv_prev,
@@ -16,8 +20,13 @@ from .env import (ParallelEnv, get_rank, get_world_size, hybrid_group,
                   init_parallel_env, is_initialized, set_hybrid_group)
 from .topology import (AXIS_ORDER, CommunicateTopology,
                        HybridCommunicateGroup, ParallelMode)
+from . import fleet
 
 __all__ = [
+    # auto-parallel
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer",
+    "get_placements", "placements_to_spec", "spec_to_placements", "fleet",
     # topology
     "AXIS_ORDER", "CommunicateTopology", "HybridCommunicateGroup",
     "ParallelMode",

@@ -1,0 +1,283 @@
+"""Auto-parallel: the DTensor programming model over GSPMD.
+
+TPU-native equivalent of the reference's dynamic auto-parallel API
+(upstream layout: python/paddle/distributed/auto_parallel/api.py +
+placement_type.py — ``ProcessMesh``, ``Shard``/``Replicate``/``Partial``,
+``shard_tensor``, ``reshard``, ``shard_layer``, ``dtensor_from_fn``).
+
+On TPU this API is nearly structural: a placement list maps 1:1 onto a
+``jax.sharding.PartitionSpec``, a distributed tensor is just a jax.Array with
+a ``NamedSharding``, and ``reshard`` is ``jax.device_put`` — XLA inserts the
+collectives the reference's Resharder pass generates by hand.  The static
+auto-parallel planner (Completer/Partitioner, upstream
+python/paddle/distributed/auto_parallel/static/) needs no equivalent at all:
+GSPMD propagation inside ``jax.jit`` *is* the planner.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer",
+    "placements_to_spec", "spec_to_placements", "get_placements",
+]
+
+
+class Placement:
+    """Base placement (parity: paddle.distributed.Placement)."""
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicate(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    """Tensor dim ``dim`` is split across this mesh dimension."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  jax.Arrays cannot *hold* partial values
+    (GSPMD reduces eagerly), so Partial is accepted only as a *source*
+    description inside shard_map-based code; :func:`shard_tensor` rejects it.
+    Kept for API parity with the reference's placement set."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """A named device mesh (parity: paddle.distributed.ProcessMesh;
+    structurally a thin wrapper over jax.sharding.Mesh).
+
+    ``ProcessMesh([[0,1],[2,3]], dim_names=["dp","mp"])`` — entries are
+    indices into ``jax.devices()``.
+    """
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray, Mesh],
+                 dim_names: Optional[Sequence[str]] = None):
+        if isinstance(mesh, Mesh):
+            self._mesh = mesh
+        else:
+            arr = np.asarray(mesh)
+            if dim_names is None:
+                dim_names = [f"d{i}" for i in range(arr.ndim)]
+            devices = np.asarray(jax.devices(), dtype=object)[arr]
+            self._mesh = Mesh(devices, tuple(dim_names))
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def shape(self):
+        return tuple(self._mesh.shape[n] for n in self._mesh.axis_names)
+
+    @property
+    def dim_names(self):
+        return tuple(self._mesh.axis_names)
+
+    @property
+    def ndim(self):
+        return len(self._mesh.axis_names)
+
+    @property
+    def process_ids(self):
+        flat = self._mesh.devices.ravel()
+        return [d.id for d in flat]
+
+    def get_dim_size(self, name: str) -> int:
+        return self._mesh.shape[name]
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and self._mesh == other._mesh
+
+    def __repr__(self):
+        dims = ", ".join(f"{n}={s}" for n, s in zip(self.dim_names, self.shape))
+        return f"ProcessMesh({dims})"
+
+
+def _as_jax_mesh(mesh) -> Mesh:
+    if isinstance(mesh, ProcessMesh):
+        return mesh.jax_mesh
+    if isinstance(mesh, Mesh):
+        return mesh
+    from . import env
+    if mesh is None:
+        hcg = env.hybrid_group()
+        if hcg is not None:
+            return hcg.mesh
+    raise TypeError(f"expected ProcessMesh/Mesh, got {mesh!r}")
+
+
+def placements_to_spec(mesh, placements: Sequence[Placement],
+                       ndim: Optional[int] = None) -> PartitionSpec:
+    """Placement list (mesh-dim-major) → PartitionSpec (tensor-dim-major).
+
+    The reference's dist_attr keeps per-mesh-dim placements; GSPMD keeps
+    per-tensor-dim axis names — this is the translation, including multi-axis
+    sharding of one tensor dim (axes ordered by mesh dim, matching the
+    reference's "co-shard" semantics).
+    """
+    m = _as_jax_mesh(mesh)
+    names = m.axis_names
+    if len(placements) != len(names):
+        raise ValueError(f"need one placement per mesh dim "
+                         f"({len(names)}), got {len(placements)}")
+    by_tensor_dim = {}
+    for mesh_dim, pl in enumerate(placements):
+        if pl.is_partial():
+            raise ValueError("Partial cannot be materialised in a "
+                             "NamedSharding; reduce it first (see Partial doc)")
+        if isinstance(pl, Shard):
+            by_tensor_dim.setdefault(pl.dim, []).append(names[mesh_dim])
+    if not by_tensor_dim:
+        return PartitionSpec()
+    max_dim = max(by_tensor_dim) + 1 if ndim is None else ndim
+    entries = []
+    for d in range(max_dim):
+        axes = by_tensor_dim.get(d)
+        if axes is None:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:  # canonical form: no trailing None
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(mesh, spec: PartitionSpec) -> List[Placement]:
+    """Inverse of :func:`placements_to_spec`."""
+    m = _as_jax_mesh(mesh)
+    out: List[Placement] = [Replicate() for _ in m.axis_names]
+    idx = {n: i for i, n in enumerate(m.axis_names)}
+    for tdim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for a in axes:
+            out[idx[a]] = Shard(tdim)
+    return out
+
+
+def shard_tensor(data, mesh=None, placements: Optional[Sequence[Placement]]
+                 = None, dtype=None):
+    """Create a distributed tensor (parity: paddle.distributed.shard_tensor).
+
+    Accepts numpy/jax input; returns a jax.Array laid out per the placements
+    (a NamedSharding) — XLA scatters/replicates as needed.
+    """
+    m = _as_jax_mesh(mesh)
+    x = jnp.asarray(data, dtype=dtype)
+    if placements is None:
+        placements = [Replicate() for _ in m.axis_names]
+    spec = placements_to_spec(m, placements, ndim=x.ndim)
+    return jax.device_put(x, NamedSharding(m, spec))
+
+
+def reshard(x, mesh=None, placements: Optional[Sequence[Placement]] = None):
+    """Change a distributed tensor's layout (parity:
+    paddle.distributed.reshard).  The reference's Resharder pass computes the
+    collective sequence; here ``jax.device_put`` → XLA does."""
+    return shard_tensor(x, mesh, placements)
+
+
+def dtensor_from_fn(fn: Callable, mesh=None, placements=None, *args, **kwargs):
+    """Build a distributed tensor from a constructor fn (parity:
+    paddle.distributed.dtensor_from_fn) — e.g. ``dtensor_from_fn(jnp.zeros,
+    mesh, [Shard(0)], (1024, 1024))``."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def get_placements(x, mesh=None) -> List[Placement]:
+    """Read back a tensor's placements (parity: ``Tensor.placements``)."""
+    m = _as_jax_mesh(mesh)
+    sharding = x.sharding
+    if isinstance(sharding, NamedSharding):
+        return spec_to_placements(m, sharding.spec)
+    return [Replicate() for _ in m.axis_names]
+
+
+def shard_layer(layer: Layer, mesh=None,
+                shard_fn: Optional[Callable[[str, Layer, "ProcessMesh"], None]]
+                = None, input_fn=None, output_fn=None) -> Layer:
+    """Shard a layer's parameters in place (parity:
+    paddle.distributed.shard_layer).
+
+    ``shard_fn(name, sublayer, mesh)`` assigns ``Parameter.sharding``
+    PartitionSpecs; afterwards every parameter value is device_put to its
+    sharding (replicated when unset).  Without ``shard_fn`` all parameters are
+    replicated across the mesh.  ``input_fn``/``output_fn`` wrap forward like
+    the reference's hooks.
+    """
+    m = _as_jax_mesh(mesh)
+    pm = ProcessMesh(m)
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, pm)
+    for _, p in layer.named_parameters(include_buffers=True):
+        spec = p.sharding if p.sharding is not None else PartitionSpec()
+        p.value = jax.device_put(p.value, NamedSharding(m, spec))
+    if input_fn is not None or output_fn is not None:
+        orig_forward = layer.forward
+
+        def wrapped(*a, **k):
+            if input_fn is not None:
+                a = input_fn(a, pm)
+            out = orig_forward(*a, **k)
+            if output_fn is not None:
+                out = output_fn(out, pm)
+            return out
+
+        object.__setattr__(layer, "forward", wrapped)
+    return layer

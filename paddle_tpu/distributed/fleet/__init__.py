@@ -1,0 +1,95 @@
+"""Fleet — the distributed-training facade.
+
+TPU-native equivalent of the reference's fleet package (upstream layout:
+python/paddle/distributed/fleet/ — fleet.py, base/strategy, meta_parallel/).
+``fleet.init(strategy)`` builds the hybrid mesh; ``distributed_model`` lays
+model parameters out on it; ``distributed_optimizer`` returns the optimizer
+unchanged (optimizer-state sharding happens in the parallelised train step,
+where the whole update is jit-compiled — see
+paddle_tpu.distributed.parallelize).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...nn.layer import Layer
+from .. import env
+from ..topology import HybridCommunicateGroup
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .strategy import DistributedStrategy
+
+__all__ = [
+    "init", "fleet_initialized", "get_hybrid_communicate_group",
+    "distributed_model", "distributed_optimizer", "DistributedStrategy",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "worker_index", "worker_num",
+]
+
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None
+         ) -> HybridCommunicateGroup:
+    """Parity: fleet.init — install the global topology from the strategy."""
+    global _strategy
+    del is_collective  # the only supported mode (PS stack is a non-goal)
+    _strategy = strategy or DistributedStrategy()
+    h = _strategy.hybrid_configs
+    return env.init_parallel_env(
+        dp_degree=h.dp_degree, mp_degree=h.mp_degree, pp_degree=h.pp_degree,
+        sharding_degree=h.sharding_degree, sep_degree=h.sep_degree)
+
+
+def fleet_initialized() -> bool:
+    return env.is_initialized()
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    """Parity: fleet.get_hybrid_communicate_group."""
+    return env.hybrid_group()
+
+
+def distributed_model(model: Layer) -> Layer:
+    """Lay the model's parameters out on the hybrid mesh (parity:
+    fleet.distributed_model).
+
+    Every parameter is device_put to its declared PartitionSpec (replicated
+    when undeclared) — the analogue of the reference broadcasting non-mp
+    params and leaving mp shards local.  The returned model is the same
+    object; the GSPMD train step does the rest.
+    """
+    hcg = env.hybrid_group()
+    if hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    mesh = hcg.mesh
+    for _, p in model.named_parameters(include_buffers=True):
+        spec = p.sharding if p.sharding is not None else PartitionSpec()
+        p.value = jax.device_put(p.value, NamedSharding(mesh, spec))
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy]
+                          = None):
+    """Parity: fleet.distributed_optimizer.  The functional optimizer needs
+    no wrapping — its state pytree is sharded by the train-step builder
+    (ZeRO stages per strategy.sharding.stage)."""
+    del strategy
+    return optimizer
+
+
+def worker_index() -> int:
+    return env.get_rank()
+
+
+def worker_num() -> int:
+    return env.get_world_size()

@@ -1,0 +1,175 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+TPU-native equivalent of the reference's mp_layers (upstream layout:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py —
+``ColumnParallelLinear``, ``RowParallelLinear``, ``VocabParallelEmbedding``,
+``ParallelCrossEntropy``).
+
+The reference implements TP with explicit collectives: identity/allreduce
+pairs (c_identity, mp_allreduce_sum) around per-rank matmul shards, masked
+lookup + allreduce for the embedding, and an allreduce-of-max + allreduce-of-
+sum custom softmax for the parallel cross entropy.
+
+Here the same math is expressed as **sharding annotations** and GSPMD inserts
+those exact collectives: the column weight is sharded on its output dim, the
+row weight on its input dim (XLA emits the psum the reference writes by
+hand), the vocab embedding on its vocab dim.  The layers therefore run
+unchanged on 1 device (specs are inert) and under jit on any mesh — there is
+no per-rank code path to keep in sync, which is the reason this design beats
+a translation.
+
+Correctness contract (tested): with identical weights, each parallel layer is
+numerically identical to its serial counterpart on any mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from .. import env
+from ..topology import canonical_axis
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "constrain",
+]
+
+
+def constrain(x, *spec_entries):
+    """Apply a sharding constraint when a global mesh is installed; no-op
+    otherwise (keeps layers runnable outside any parallel context)."""
+    hcg = env.hybrid_group()
+    if hcg is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(hcg.mesh, P(*spec_entries)))
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the weight's *output* dim sharded on the mp axis.
+
+    ``gather_output=True`` replicates the output (the reference's c_concat);
+    the default keeps it sharded for a following RowParallelLinear.
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 gather_output: bool = False, dtype=None,
+                 mp_axis: str = "mp"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.mp_axis = canonical_axis(mp_axis)
+        w_init = weight_attr if weight_attr is not None else I.XavierNormal()
+        self.weight = self.create_parameter(
+            (in_features, out_features), dtype=dtype, initializer=w_init,
+            sharding=P(None, self.mp_axis), attr_name="weight")
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), dtype=dtype, initializer=I.Constant(0.0),
+                sharding=P(self.mp_axis), attr_name="bias")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = constrain(y, *([None] * y.ndim))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with the weight's *input* dim sharded on the mp axis.
+
+    With ``input_is_parallel=True`` (fed by a ColumnParallelLinear) the
+    contraction runs on sharded activations and XLA emits the partial-sum
+    all-reduce the reference codes as mp_allreduce_sum.
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 input_is_parallel: bool = True, dtype=None,
+                 mp_axis: str = "mp"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.mp_axis = canonical_axis(mp_axis)
+        w_init = weight_attr if weight_attr is not None else I.XavierNormal()
+        self.weight = self.create_parameter(
+            (in_features, out_features), dtype=dtype, initializer=w_init,
+            sharding=P(self.mp_axis, None), attr_name="weight")
+        if has_bias:
+            # bias is applied after the implicit allreduce → replicated
+            self.bias = self.create_parameter(
+                (out_features,), dtype=dtype, initializer=I.Constant(0.0),
+                sharding=P(None), attr_name="bias")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            # hint GSPMD that the activation's last dim matches the weight's
+            # sharded input dim, so the matmul contracts locally then psums
+            x = constrain(x, *([None] * (x.ndim - 1)), self.mp_axis)
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded on the mp axis.
+
+    The reference masks out-of-shard ids, looks up locally and all-reduces;
+    XLA lowers the sharded gather to the same pattern.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, dtype=None, mp_axis: str = "mp"):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.mp_axis = canonical_axis(mp_axis)
+        w_init = weight_attr if weight_attr is not None else I.Normal(std=0.02)
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), dtype=dtype, initializer=w_init,
+            sharding=P(self.mp_axis, None), attr_name="weight")
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross entropy over vocab-sharded logits.
+
+    The reference's custom op computes a numerically-stable softmax with two
+    hand-written allreduces (max, sum) so the full logits row never
+    materialises on one rank.  The jnp formulation below has the identical
+    dataflow — row max, exp-sum, gather of the label logit — and GSPMD emits
+    those same two reductions when the last dim is sharded; the constraint
+    keeps logits sharded so the allgather never happens.
+    """
+
+    def __init__(self, mp_axis: str = "mp", ignore_index: int = -100):
+        super().__init__()
+        self.mp_axis = canonical_axis(mp_axis)
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        logits = constrain(
+            logits, *([None] * (logits.ndim - 1)), self.mp_axis)
+        logits = logits.astype(jnp.float32)
+        m = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+        shifted = logits - m
+        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+        label_logit = jnp.take_along_axis(
+            shifted, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+        loss = lse - label_logit
+        return jnp.where(labels == self.ignore_index,
+                         jnp.zeros_like(loss), loss)

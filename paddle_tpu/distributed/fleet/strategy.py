@@ -1,0 +1,105 @@
+"""DistributedStrategy — the structured training-strategy config.
+
+TPU-native equivalent of the reference's protobuf-backed strategy
+(upstream layout: python/paddle/distributed/fleet/base/distributed_strategy.py
++ paddle/fluid/framework/distributed_strategy.proto).  A protobuf buys the
+reference cross-language C++/Python access; here everything that consumes the
+strategy is Python driving XLA, so plain dataclasses are the idiomatic form —
+same field names, validated, serialisable via ``to_dict``/``from_dict``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+__all__ = ["DistributedStrategy", "HybridConfig", "AmpConfig",
+           "RecomputeConfig", "PipelineConfig", "ShardingConfig"]
+
+
+@dataclasses.dataclass
+class HybridConfig:
+    """Parallel degrees (parity: strategy.hybrid_configs dict)."""
+
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+
+    def degrees(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AmpConfig:
+    """Parity: strategy.amp + amp_configs."""
+
+    enable: bool = False
+    dtype: str = "bfloat16"  # the TPU-native default; reference uses float16
+    level: str = "O1"
+    init_loss_scaling: float = 32768.0
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: tuple = ()
+    custom_black_list: tuple = ()
+
+
+@dataclasses.dataclass
+class RecomputeConfig:
+    """Parity: strategy.recompute + recompute_configs."""
+
+    enable: bool = False
+    # names of layers (dotted prefixes) to checkpoint; empty = every block
+    checkpoints: tuple = ()
+    # jax.checkpoint policy name: "nothing" | "dots" | "dots_with_no_batch_dims"
+    policy: str = "nothing"
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    """Parity: strategy.pipeline_configs."""
+
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"  # "FThenB" | "1F1B"
+
+
+@dataclasses.dataclass
+class ShardingConfig:
+    """Parity: strategy.sharding_configs (ZeRO stage selection)."""
+
+    stage: int = 1  # 1: opt states, 2: +grads, 3: +params
+
+
+@dataclasses.dataclass
+class DistributedStrategy:
+    """Parity: fleet.DistributedStrategy."""
+
+    hybrid_configs: HybridConfig = dataclasses.field(default_factory=HybridConfig)
+    amp: AmpConfig = dataclasses.field(default_factory=AmpConfig)
+    recompute: RecomputeConfig = dataclasses.field(default_factory=RecomputeConfig)
+    pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    sharding: ShardingConfig = dataclasses.field(default_factory=ShardingConfig)
+    gradient_merge_k_steps: int = 1
+    find_unused_parameters: bool = False
+
+    def __post_init__(self):
+        # accept the reference's dict spelling:
+        #   DistributedStrategy(hybrid_configs={"mp_degree": 2, ...})
+        if isinstance(self.hybrid_configs, dict):
+            self.hybrid_configs = HybridConfig(**self.hybrid_configs)
+        if isinstance(self.amp, dict):
+            self.amp = AmpConfig(**self.amp)
+        if isinstance(self.recompute, dict):
+            self.recompute = RecomputeConfig(**self.recompute)
+        if isinstance(self.pipeline, dict):
+            self.pipeline = PipelineConfig(**self.pipeline)
+        if isinstance(self.sharding, dict):
+            self.sharding = ShardingConfig(**self.sharding)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DistributedStrategy":
+        return cls(**d)
