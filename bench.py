@@ -1,0 +1,135 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Measures MFU (and tokens/sec/chip) for Llama-3-8B-architecture training on
+the available accelerator, per BASELINE.md's measurement plan: 6ND flops
+approximation, steady-state steps after warmup, block_until_ready on the
+step output only.  On a single chip the model is layer-scaled (full 8B
+hidden dims, fewer layers) so params + AdamW fp32 state fit in HBM; MFU is
+flops-normalised so it transfers to the full-depth model.
+
+vs_baseline = MFU / 0.45 (the north-star target; the reference publishes no
+number of its own — BASELINE.md).
+"""
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--layers", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (LlamaForCausalLM, llama3_8b_config,
+                                   tiny_llama_config)
+    from paddle_tpu.optimizer import AdamW
+
+    dev = jax.devices()[0]
+    platform, kind = dev.platform, dev.device_kind
+    n_chips = len(jax.devices())
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        # full Llama-3-8B hidden dims; depth/vocab scaled so params + AdamW
+        # fp32 state (~14 bytes/param total) fit the chip's HBM
+        if "v5 lite" in kind or "v5e" in kind:  # 16 GB HBM
+            peak_flops = 197e12
+            trials = [(2, 32000, 4, 2048), (2, 32000, 2, 2048),
+                      (1, 32000, 2, 1024)]
+        else:  # v5p-class, 95 GB HBM
+            peak_flops = 459e12
+            trials = [(4, 128256, 4, 4096), (4, 128256, 2, 4096),
+                      (2, 32000, 2, 2048)]
+        if args.layers or args.batch or args.seq:
+            t = trials[0]
+            trials = [(args.layers or t[0], t[1], args.batch or t[2],
+                       args.seq or t[3])]
+        steps, warmup = args.steps, args.warmup
+    else:
+        peak_flops = None
+        trials = [(2, 256, args.batch or 8, args.seq or 64)]
+        steps, warmup = min(args.steps, 5), 2
+
+    hcg = dist.HybridCommunicateGroup(devices=jax.devices())
+    dist.set_hybrid_group(hcg)
+
+    def attempt(layers, vocab, batch, seq):
+        pt.seed(0)
+        if on_tpu:
+            cfg = llama3_8b_config(num_hidden_layers=layers, vocab_size=vocab,
+                                   recompute=True,
+                                   max_position_embeddings=seq)
+        else:
+            cfg = tiny_llama_config()
+        model = LlamaForCausalLM(cfg)
+        n_params = sum(int(np.prod(p.shape)) for _, p in
+                       model.named_parameters() if p.trainable)
+        opt = AdamW(learning_rate=1e-4, weight_decay=0.01)
+        step, params, opt_state = dist.build_train_step(model, opt, hcg=hcg,
+                                                        zero_stage=1)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+        b = dist.shard_batch({"input_ids": jnp.asarray(ids[:, :-1]),
+                              "labels": jnp.asarray(ids[:, 1:])}, hcg)
+        key = jax.random.key(0)
+        loss = None
+        for i in range(warmup):
+            loss, params, opt_state = step(params, opt_state, b,
+                                           jax.random.fold_in(key, i))
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss, params, opt_state = step(params, opt_state, b,
+                                           jax.random.fold_in(key, warmup + i))
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0, float(loss), n_params, cfg)
+
+    err = None
+    for layers, vocab, batch, seq in trials:
+        try:
+            dt, loss_v, n_params, cfg = attempt(layers, vocab, batch, seq)
+            break
+        except Exception as e:  # OOM → try the next smaller config
+            err = e
+            if "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+    else:
+        raise err
+    loss = loss_v
+
+    step_time = dt / steps
+    tokens_per_sec_chip = batch * seq / step_time / n_chips
+    model_flops = 6.0 * n_params * batch * seq  # 6ND, no attention correction
+    if peak_flops is not None:
+        mfu = model_flops / step_time / (peak_flops * n_chips)
+        out = {"metric": "mfu_llama3_8b_arch", "value": round(mfu, 4),
+               "unit": "fraction_of_peak_bf16",
+               "vs_baseline": round(mfu / 0.45, 4),
+               "detail": {"tokens_per_sec_per_chip": round(tokens_per_sec_chip),
+                          "params": n_params, "layers": cfg.num_hidden_layers,
+                          "batch": batch, "seq": seq, "chips": n_chips,
+                          "step_time_s": round(step_time, 4),
+                          "loss": float(loss)}}
+    else:
+        out = {"metric": "tokens_per_sec_per_chip_tiny_cpu",
+               "value": round(tokens_per_sec_chip, 1), "unit": "tokens/s",
+               "vs_baseline": 0.0,
+               "detail": {"platform": platform, "params": n_params,
+                          "step_time_s": round(step_time, 4),
+                          "loss": float(loss)}}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

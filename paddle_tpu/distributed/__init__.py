@@ -18,6 +18,9 @@ from .collective import (AxisGroup, ReduceOp, all_gather, all_reduce,
                          reduce_scatter, send_next)
 from .env import (ParallelEnv, get_rank, get_world_size, hybrid_group,
                   init_parallel_env, is_initialized, set_hybrid_group)
+from .parallelize import (build_eval_step, build_train_step,
+                          optimizer_state_shardings, param_shardings,
+                          shard_batch, zero_shard_spec)
 from .topology import (AXIS_ORDER, CommunicateTopology,
                        HybridCommunicateGroup, ParallelMode)
 from . import fleet
@@ -27,6 +30,9 @@ __all__ = [
     "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer",
     "get_placements", "placements_to_spec", "spec_to_placements", "fleet",
+    # parallelize
+    "build_train_step", "build_eval_step", "shard_batch", "param_shardings",
+    "optimizer_state_shardings", "zero_shard_spec",
     # topology
     "AXIS_ORDER", "CommunicateTopology", "HybridCommunicateGroup",
     "ParallelMode",

@@ -1,0 +1,15 @@
+"""In-tree model families.
+
+The reference keeps models in separate repos (PaddleNLP, PaddleMIX); they are
+in-tree here because they are the benchmark workloads the framework is
+measured on (BASELINE.md) and they double as integration tests of the hybrid
+parallel stack.
+"""
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    llama3_8b_config, tiny_llama_config)
+
+__all__ = [
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama3_8b_config",
+    "tiny_llama_config",
+]

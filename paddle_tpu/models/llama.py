@@ -1,0 +1,251 @@
+"""Llama-family decoder — the flagship model.
+
+The reference keeps model code out-of-tree (PaddleNLP's modeling_llama builds
+on the framework's fused_attention / fused_rope / mp_layers / PipelineLayer);
+here the model is in-tree because it is the north-star benchmark workload
+(BASELINE.md: Llama-3-8B hybrid-parallel tokens/sec/chip + MFU).
+
+TPU-first design decisions:
+  * every parameter carries its hybrid-parallel ``PartitionSpec`` at creation
+    (tp on the ``mp`` axis, FSDP/ZeRO-3 on the ``sharding`` axis) — GSPMD
+    inserts the all-gathers/psums that the reference's mp_layers +
+    group_sharded stage-3 implement by hand;
+  * attention runs through ``paddle_tpu.ops.flash_attention`` (Pallas kernel
+    on TPU, returns LSE so ring/context parallelism can merge blocks);
+  * RoPE caches are fp32 buffers, activations bf16, losses/reductions fp32;
+  * activation layout is (batch, seq, hidden) with batch sharded over
+    (dp, sharding) and seq over sep (context parallelism) via sharding
+    constraints between blocks;
+  * recompute ≙ ``jax.checkpoint`` around each decoder block
+    (config.recompute), the reference's fleet recompute equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.fleet.mp_layers import constrain
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.common import RMSNorm
+from ..nn.layer import Layer
+from ..ops import build_rope_cache, flash_attention, fused_rope
+
+__all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
+           "LlamaModel", "LlamaForCausalLM", "llama3_8b_config",
+           "tiny_llama_config"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    dtype: str = "float32"
+    recompute: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama3_8b_config(**overrides) -> LlamaConfig:
+    """Llama-3-8B (the BASELINE.md workload)."""
+    cfg = LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=8192, rms_norm_eps=1e-5, rope_theta=500000.0,
+        dtype="bfloat16")
+    return dataclasses.replace(cfg, **overrides)
+
+
+def tiny_llama_config(**overrides) -> LlamaConfig:
+    """Small config for tests/dry runs."""
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128)
+    return dataclasses.replace(cfg, **overrides)
+
+
+def _batch_spec(ndim: int) -> Tuple:
+    """Activation sharding: batch over (dp, sharding), seq over sep."""
+    return (("dp", "sharding"), "sep") + (None,) * (ndim - 2)
+
+
+class LlamaAttention(Layer):
+    """GQA attention with RoPE and flash attention.
+
+    TP: head dims sharded on ``mp`` (column-parallel qkv, row-parallel o);
+    FSDP: the other weight dim sharded on ``sharding``.
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        hd, nh, nkv = c.head_dim, c.num_attention_heads, c.num_key_value_heads
+        init = I.Normal(std=c.initializer_range)
+        self.q_proj = self.create_parameter(
+            (c.hidden_size, nh * hd), dtype=c.dtype, initializer=init,
+            sharding=P("sharding", "mp"), attr_name="q_proj")
+        self.k_proj = self.create_parameter(
+            (c.hidden_size, nkv * hd), dtype=c.dtype, initializer=init,
+            sharding=P("sharding", "mp"), attr_name="k_proj")
+        self.v_proj = self.create_parameter(
+            (c.hidden_size, nkv * hd), dtype=c.dtype, initializer=init,
+            sharding=P("sharding", "mp"), attr_name="v_proj")
+        self.o_proj = self.create_parameter(
+            (nh * hd, c.hidden_size), dtype=c.dtype, initializer=init,
+            sharding=P("mp", "sharding"), attr_name="o_proj")
+
+    def forward(self, x, rope_cache, position_ids=None, kv_cache=None):
+        c = self.config
+        b, s, _ = x.shape
+        q = (x @ self.q_proj).reshape(b, s, c.num_attention_heads, c.head_dim)
+        k = (x @ self.k_proj).reshape(b, s, c.num_key_value_heads, c.head_dim)
+        v = (x @ self.v_proj).reshape(b, s, c.num_key_value_heads, c.head_dim)
+        cos, sin = rope_cache
+        q, k = fused_rope(q, k, cos, sin, position_ids)
+        if kv_cache is not None:  # decode path: append to cache
+            pk, pv = kv_cache
+            k = jnp.concatenate([pk, k], axis=1)
+            v = jnp.concatenate([pv, v], axis=1)
+            kv_cache = (k, v)
+        # heads on mp, batch on (dp, sharding), seq on sep
+        q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
+        k = constrain(k, ("dp", "sharding"), None, "mp", None)
+        v = constrain(v, ("dp", "sharding"), None, "mp", None)
+        out = flash_attention(q, k, v, causal=True)
+        out = out.reshape(b, s, -1) @ self.o_proj
+        if kv_cache is not None:
+            return out, kv_cache
+        return out
+
+
+class LlamaMLP(Layer):
+    """SwiGLU MLP — gate/up column-parallel, down row-parallel."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        init = I.Normal(std=c.initializer_range)
+        self.gate_proj = self.create_parameter(
+            (c.hidden_size, c.intermediate_size), dtype=c.dtype,
+            initializer=init, sharding=P("sharding", "mp"),
+            attr_name="gate_proj")
+        self.up_proj = self.create_parameter(
+            (c.hidden_size, c.intermediate_size), dtype=c.dtype,
+            initializer=init, sharding=P("sharding", "mp"),
+            attr_name="up_proj")
+        self.down_proj = self.create_parameter(
+            (c.intermediate_size, c.hidden_size), dtype=c.dtype,
+            initializer=init, sharding=P("mp", "sharding"),
+            attr_name="down_proj")
+
+    def forward(self, x):
+        return F.swiglu(x @ self.gate_proj, x @ self.up_proj) @ self.down_proj
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps,
+                                       dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps,
+                                                dtype=config.dtype)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, rope_cache, position_ids=None):
+        x = x + self.self_attn(self.input_layernorm(x), rope_cache,
+                               position_ids)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return constrain(x, *_batch_spec(x.ndim))
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embed_tokens = self.create_parameter(
+            (c.vocab_size, c.hidden_size), dtype=c.dtype,
+            initializer=I.Normal(std=c.initializer_range),
+            sharding=P("mp", "sharding"), attr_name="embed_tokens")
+        from ..nn.layer import LayerList
+        self.layers = LayerList(
+            [LlamaDecoderLayer(c) for _ in range(c.num_hidden_layers)])
+        self.norm = RMSNorm(c.hidden_size, epsilon=c.rms_norm_eps,
+                            dtype=c.dtype)
+        cos, sin = build_rope_cache(c.max_position_embeddings, c.head_dim,
+                                    base=c.rope_theta)
+        self.register_buffer("rope_cos", cos)
+        self.register_buffer("rope_sin", sin)
+
+    def forward(self, input_ids, position_ids=None):
+        c = self.config
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = constrain(x, *_batch_spec(x.ndim))
+        rope = (self.rope_cos, self.rope_sin)
+        for block in self.layers:
+            if c.recompute and self.training:
+                x = jax.checkpoint(
+                    lambda h, blk=block: blk(h, rope, position_ids))(x)
+            else:
+                x = block(x, rope, position_ids)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    """Causal LM head + loss (the train-step entry the benchmarks drive)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = self.create_parameter(
+                (config.hidden_size, config.vocab_size), dtype=config.dtype,
+                initializer=I.Normal(std=config.initializer_range),
+                sharding=P("sharding", "mp"), attr_name="lm_head")
+
+    def logits(self, hidden):
+        if self.config.tie_word_embeddings:
+            w = self.model.embed_tokens
+            return hidden @ w.T
+        return hidden @ self.lm_head
+
+    def forward(self, input_ids, position_ids=None):
+        return self.logits(self.model(input_ids, position_ids))
+
+    def compute_loss(self, input_ids, labels, position_ids=None):
+        """Mean next-token cross entropy in fp32 over vocab-sharded logits
+        (the ParallelCrossEntropy dataflow: no logits all-gather)."""
+        logits = self.forward(input_ids, position_ids)
+        logits = constrain(logits, ("dp", "sharding"), "sep", "mp")
+        logits = logits.astype(jnp.float32)
+        m = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+        shifted = logits - m
+        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+        gold = jnp.take_along_axis(
+            shifted, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+        loss = lse - gold
+        valid = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)

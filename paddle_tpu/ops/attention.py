@@ -72,8 +72,14 @@ def flash_attention_reference(q, k, v, attn_mask=None, dropout_p: float = 0.0,
     m = jnp.max(scores, axis=-1, keepdims=True)
     m = jnp.maximum(m, NEG_INF)  # guard fully-masked rows
     p = jnp.exp(scores - m)
+    # fully-masked rows (m == NEG_INF): exp(NEG_INF - NEG_INF) = 1 would make
+    # them mean-of-v; define out = 0, lse = NEG_INF instead (the flash-attn
+    # convention, matched by the Pallas kernel)
+    dead = m <= NEG_INF / 2
+    p = jnp.where(dead, 0.0, p)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    lse = (m + jnp.log(jnp.maximum(l, 1e-37))).squeeze(-1)  # (B, H, Sq)
+    lse = jnp.where(dead, NEG_INF,
+                    m + jnp.log(jnp.maximum(l, 1e-37))).squeeze(-1)  # (B,H,Sq)
 
     p = p / jnp.maximum(l, 1e-37)
     if dropout_p > 0.0:

@@ -1,9 +1,382 @@
-"""Blocked flash-attention Pallas kernel (placeholder gate).
+"""Blocked flash-attention Pallas TPU kernel (forward + backward).
 
-The real kernel lands with the Llama milestone; until then dispatch falls
-back to the XLA reference implementation.
+TPU-native equivalent of the reference's flash-attention integration
+(upstream layout: paddle/phi/kernels/gpu/flash_attn_kernel.cu +
+flash_attn_grad_kernel.cu, which wrap the external CUDA flashattn library).
+Here the kernel is first-party, written for the MXU/VMEM architecture:
+
+  * online-softmax forward (Flash-2): the KV loop is the innermost grid
+    dimension; running max ``m``, normaliser ``l`` and the fp32 accumulator
+    live in VMEM scratch that persists across that dimension, so the
+    (Sq, Skv) score matrix never exists in HBM;
+  * returns the per-row log-sum-exp (``softmax_lse`` in the reference's
+    API) — the hook that makes ring/context-parallel attention possible;
+  * backward recomputes scores blockwise from (q, k, v, out, lse) — the
+    Flash-2 two-kernel scheme: one accumulating dq over KV blocks, one
+    accumulating dk/dv over Q blocks, with ``delta = rowsum(dO·O)``
+    precomputed in XLA;
+  * GQA: K/V keep their own (fewer) heads; the BlockSpec index maps fold
+    the q-head → kv-head mapping, so grouped KV is never broadcast in HBM;
+  * causal masking is bottom-right aligned (matches the reference's
+    flash-attn convention when Sq < Skv) and fully-masked tiles skip their
+    matmuls via ``pl.when``.
+
+Layout: public API takes (B, S, H, D) (the reference's flash-attn layout);
+kernels run in (B, H, S, D).
 """
 
+from __future__ import annotations
 
-def flash_attention_pallas(q, k, v, causal=False, scale=None, interpret=False):
-    raise NotImplementedError
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # VPU lane width: m/l scratch rows are padded to this
+
+
+def _block_sizes(sq: int, skv: int):
+    bq = min(256, sq)
+    bk = min(512, skv)
+    return bq, bk
+
+
+def _validate(q, k, v, sq, skv, bq, bk):
+    if sq % bq or skv % bk:
+        raise NotImplementedError(
+            f"flash kernel needs seq divisible by block ({sq}%{bq}, "
+            f"{skv}%{bk})")
+    if q.shape[-1] != k.shape[-1] or k.shape[:2] != v.shape[:2]:
+        raise NotImplementedError("q/k/v head_dim mismatch")
+    if k.shape[1] == 0 or q.shape[1] % k.shape[1]:
+        raise NotImplementedError("q heads must be a multiple of kv heads")
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, scale, causal, offset, bq, bk,
+                kv_steps):
+    ki = pl.program_id(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    q_start = qi * bq
+    kv_start = ki * bk
+    # bottom-right causal: query row i attends to kv cols <= i + offset;
+    # fully-masked tiles skip their matmuls entirely
+    run = (kv_start <= q_start + (bq - 1) + offset) if causal \
+        else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (cols + kv_start) <= (rows + q_start + offset)
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_sc[:, :1]                                   # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)              # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                        # rescale old
+        p = jnp.exp(s - m_new)                                 # (bq, bk)
+        if causal:
+            # exp(NEG_INF - NEG_INF) = 1 for fully-masked rows; zero it
+            p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)                    # (bk, d)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + pv
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(ki == kv_steps - 1)
+    def _finish():
+        l = l_sc[:, :1]
+        safe_l = jnp.maximum(l, 1e-37)
+        o_ref[0, 0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+        lse = m_sc[:, :1] + jnp.log(safe_l)
+        # fully-masked rows: lse = -inf-ish, out = 0 (matches reference).
+        # lane dim broadcast to _LANES: TPU block tiling needs a 128 last dim
+        lse_ref[0, 0] = jnp.broadcast_to(
+            jnp.where(l > 0, lse, NEG_INF), (lse.shape[0], lse_ref.shape[-1]))
+
+
+def _fwd(q, k, v, scale: float, causal: bool, interpret: bool = False):
+    """q: (B, Hq, Sq, D); k, v: (B, Hkv, Skv, D) → (out, lse)."""
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    g = hq // hkv
+    bq, bk = _block_sizes(sq, skv)
+    offset = skv - sq
+    kv_steps = skv // bk
+
+    grid = (b, hq, sq // bq, skv // bk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, offset=offset, bq=bq,
+        bk=bk, kv_steps=kv_steps)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_sc, *, scale, causal, offset, bq, bk, kv_steps):
+    ki = pl.program_id(3)
+    qi = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    q_start = qi * bq
+    kv_start = ki * bk
+    run = (kv_start <= q_start + (bq - 1) + offset) if causal \
+        else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]                       # (bq, 1)
+        delta = delta_ref[0, 0][:, :1]                   # (bq, 1)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (cols + kv_start) <= (rows + q_start + offset)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # (bq, bk)
+        if causal:
+            p = jnp.where(mask, p, 0.0)  # kill exp(NEG_INF - NEG_INF) = 1
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_sc[:] += jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == kv_steps - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, offset,
+                    bq, bk, q_steps):
+    qi = pl.program_id(3)
+    ki = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_start = qi * bq
+    kv_start = ki * bk
+    run = (kv_start <= q_start + (bq - 1) + offset) if causal \
+        else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (cols + kv_start) <= (rows + q_start + offset)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # (bq, bk)
+        if causal:
+            p = jnp.where(mask, p, 0.0)  # kill exp(NEG_INF - NEG_INF) = 1
+        dv_sc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                     # (bq, bk)
+        dk_sc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == q_steps - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, interpret, res, grads):
+    q, k, v, out, lse = res
+    do, dlse = grads
+    do = do.astype(q.dtype)
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    g = hq // hkv
+    bq, bk = _block_sizes(sq, skv)
+    offset = skv - sq
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # (b, hq, sq)
+    # the lse cotangent folds into the ds formula exactly:
+    #   ds = p*(dp - delta)*scale + p*dlse*scale = p*(dp - (delta-dlse))*scale
+    delta = delta - dlse.astype(jnp.float32)
+    # lane-broadcast lse/delta for TPU block tiling (last dim = _LANES)
+    lse4 = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANES))
+    delta4 = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, offset=offset, bq=bq,
+        bk=bk, kv_steps=skv // bk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, hq, sq // bq, skv // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda b_, h, qi, ki: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse4, delta4)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, offset=offset, bq=bq,
+        bk=bk, q_steps=sq // bq)
+    # per-q-head dk/dv; grouped heads are reduced after the kernel
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, hq, skv // bk, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, ki, qi: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, ki, qi: (b_, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, ki, qi: (b_, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, ki, qi: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda b_, h, ki, qi: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda b_, h, ki, qi: (b_, h, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h, ki, qi: (b_, h, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h, ki, qi: (b_, h, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hq, skv, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse4, delta4)
+    if g > 1:
+        dk = dk.reshape(b, hkv, g, skv, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, hkv, g, skv, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, interpret):
+    return _fwd(q, k, v, scale, causal, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, interpret):
+    out, lse = _fwd(q, k, v, scale, causal, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention_pallas(q, k, v, causal: bool = False,
+                           scale: Optional[float] = None,
+                           interpret: bool = False):
+    """(B, S, H, D) flash attention → (out (B,S,H,D), lse (B,H,S))."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if scale is None:
+        scale = d ** -0.5
+    bq, bk = _block_sizes(sq, skv)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    _validate(qt, kt, vt, sq, skv, bq, bk)
+    out, lse = _flash(qt, kt, vt, float(scale), bool(causal),
+                      bool(interpret))
+    return jnp.swapaxes(out, 1, 2), lse

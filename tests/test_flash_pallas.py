@@ -1,0 +1,155 @@
+"""Pallas flash-attention kernel vs the XLA reference oracle.
+
+Runs the kernel in Pallas interpreter mode on the CPU backend (the
+fake-backend strategy of SURVEY.md §4); the same kernel compiles via Mosaic
+on real TPU.  Forward (out + LSE) and backward (dq/dk/dv vs jax.grad of the
+reference) across causal/non-causal, GQA, and Sq < Skv.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.attention import flash_attention_reference
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+
+
+def _rand(shape, seed):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape).astype(np.float32))
+
+
+CASES = [
+    # (b, sq, skv, hq, hkv, d, causal)
+    (1, 256, 256, 2, 2, 64, False),
+    (1, 256, 256, 2, 2, 64, True),
+    (2, 256, 512, 4, 2, 32, True),    # GQA + Sq < Skv (decode-ish)
+    (1, 512, 1024, 2, 1, 64, True),   # multi q-block, multi kv-step
+]
+
+
+@pytest.mark.parametrize("b,sq,skv,hq,hkv,d,causal", CASES)
+def test_fwd_matches_reference(b, sq, skv, hq, hkv, d, causal):
+    q = _rand((b, sq, hq, d), 0)
+    k = _rand((b, skv, hkv, d), 1)
+    v = _rand((b, skv, hkv, d), 2)
+    out, lse = flash_attention_pallas(q, k, v, causal=causal, interpret=True)
+    ref, ref_lse = flash_attention_reference(q, k, v, causal=causal,
+                                             return_lse=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("b,sq,skv,hq,hkv,d,causal", CASES[:3])
+def test_bwd_matches_reference(b, sq, skv, hq, hkv, d, causal):
+    q = _rand((b, sq, hq, d), 10)
+    k = _rand((b, skv, hkv, d), 11)
+    v = _rand((b, skv, hkv, d), 12)
+    w = _rand((b, sq, hq, d), 13)  # cotangent weighting
+
+    def loss_pallas(q, k, v):
+        out, _ = flash_attention_pallas(q, k, v, causal=causal,
+                                        interpret=True)
+        return jnp.sum(out * w)
+
+    def loss_ref(q, k, v):
+        out = flash_attention_reference(q, k, v, causal=causal,
+                                        return_lse=False)
+        return jnp.sum(out * w)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_bf16_inputs():
+    q = _rand((1, 256, 2, 64), 20).astype(jnp.bfloat16)
+    k = _rand((1, 256, 2, 64), 21).astype(jnp.bfloat16)
+    v = _rand((1, 256, 2, 64), 22).astype(jnp.bfloat16)
+    out, lse = flash_attention_pallas(q, k, v, causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16 and lse.dtype == jnp.float32
+    ref = flash_attention_reference(q, k, v, causal=True, return_lse=False)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_short_seq_single_block():
+    # seq < block size: whole seq becomes one block, no alignment needed
+    q = _rand((1, 100, 2, 64), 30)
+    out, _ = flash_attention_pallas(q, q, q, causal=True, interpret=True)
+    ref = flash_attention_reference(q, q, q, causal=True, return_lse=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_unaligned_long_seq_raises():
+    q = _rand((1, 300, 2, 64), 31)  # > block size, not divisible
+    with pytest.raises(NotImplementedError):
+        flash_attention_pallas(q, q, q, interpret=True)
+
+
+@pytest.mark.parametrize("skv", [256, 384])  # block-aligned and misaligned
+def test_causal_sq_gt_skv_fully_masked_rows(skv):
+    """Sq > Skv causal: the first Sq-Skv rows attend to nothing.  Both paths
+    must return out = 0, lse = NEG_INF there, with clean gradients."""
+    from paddle_tpu.ops.attention import NEG_INF
+    q = _rand((1, 512, 2, 64), 40)
+    k = _rand((1, skv, 2, 64), 41)
+    v = _rand((1, skv, 2, 64), 42)
+    n_dead = 512 - skv
+    out, lse = flash_attention_pallas(q, k, v, causal=True, interpret=True)
+    ref, ref_lse = flash_attention_reference(q, k, v, causal=True,
+                                             return_lse=True)
+    np.testing.assert_allclose(np.asarray(out[:, :n_dead]), 0.0)
+    np.testing.assert_allclose(np.asarray(ref[:, :n_dead]), 0.0)
+    assert np.all(np.asarray(lse)[:, :, :n_dead] <= NEG_INF / 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    w = _rand((1, 512, 2, 64), 43)
+
+    def loss_p(q, k, v):
+        o, _ = flash_attention_pallas(q, k, v, causal=True, interpret=True)
+        return jnp.sum(o * w)
+
+    def loss_r(q, k, v):
+        o = flash_attention_reference(q, k, v, causal=True, return_lse=False)
+        return jnp.sum(o * w)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_lse_cotangent_flows():
+    """Gradients through the returned LSE must match the reference path
+    (the ring-attention merge differentiates through lse)."""
+    q = _rand((1, 256, 2, 64), 50)
+    k = _rand((1, 256, 2, 64), 51)
+    v = _rand((1, 256, 2, 64), 52)
+    wl = _rand((1, 2, 256), 53)
+
+    def loss_p(q, k, v):
+        o, lse = flash_attention_pallas(q, k, v, causal=True, interpret=True)
+        return jnp.sum(o) + jnp.sum(lse * wl)
+
+    def loss_r(q, k, v):
+        o, lse = flash_attention_reference(q, k, v, causal=True,
+                                           return_lse=True)
+        return jnp.sum(o) + jnp.sum(lse * wl)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
