@@ -23,9 +23,11 @@ from .parallelize import (build_eval_step, build_train_step,
                           shard_batch, zero_shard_spec)
 from .topology import (AXIS_ORDER, CommunicateTopology,
                        HybridCommunicateGroup, ParallelMode)
-from . import fleet
+from . import checkpoint, fleet
+from .checkpoint import load_state_dict, save_state_dict
 
 __all__ = [
+    "checkpoint", "save_state_dict", "load_state_dict",
     # auto-parallel
     "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer",

@@ -31,8 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..framework import random as _random
-from ..nn.layer import Layer
+from ..nn.layer import Layer, bind_params
 from . import env
 
 __all__ = ["build_train_step", "build_eval_step", "zero_shard_spec",
@@ -151,19 +150,8 @@ def build_train_step(model: Layer, optimizer,
     opt_state = jax.tree.map(jax.device_put, opt_state, o_shard)
 
     def call_loss(p, batch, rng):
-        # bind the param pytree onto the live module (functional bridge),
-        # run the user loss under a pinned RNG, restore
-        handles = dict(model.named_parameters(include_buffers=True))
-        old = {}
-        try:
-            for k, v in p.items():
-                old[k] = handles[k].value
-                handles[k].value = v
-            with _random.rng_guard(rng):
-                return loss_fn(model, batch)
-        finally:
-            for k, v in old.items():
-                handles[k].value = v
+        with bind_params(model, p, rng=rng):
+            return loss_fn(model, batch)
 
     def step(p, o, batch, rng):
         if grad_accum_steps == 1:
@@ -203,19 +191,7 @@ def build_eval_step(model: Layer, hcg=None, fn: Optional[Callable] = None):
     fn = fn or (lambda m, batch: m(**batch))
 
     def run(p, batch):
-        handles = dict(model.named_parameters(include_buffers=True))
-        old = {}
-        was_training = model.training
-        try:
-            for k, v in p.items():
-                old[k] = handles[k].value
-                handles[k].value = v
-            model.eval()
+        with bind_params(model, p, eval_mode=True):
             return fn(model, batch)
-        finally:
-            if was_training:
-                model.train()
-            for k, v in old.items():
-                handles[k].value = v
 
     return jax.jit(run)

@@ -7,12 +7,13 @@ import numpy as np
 from . import dtype as dtype  # noqa: PLC0414
 from . import random as random  # noqa: PLC0414
 from .dtype import get_default_dtype, set_default_dtype, to_jax_dtype
+from .io import load, save
 from .random import get_rng_state_tracker, seed
 
 __all__ = [
     "dtype", "random", "seed", "get_rng_state_tracker",
     "get_default_dtype", "set_default_dtype", "to_jax_dtype",
-    "to_tensor", "device_count", "is_compiled_with_tpu",
+    "to_tensor", "device_count", "is_compiled_with_tpu", "save", "load",
 ]
 
 

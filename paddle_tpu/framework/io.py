@@ -1,0 +1,49 @@
+"""Core save/load: whole-object checkpoints.
+
+TPU-native equivalent of the reference's ``paddle.save``/``paddle.load``
+(upstream layout: python/paddle/framework/io.py — pickle-based state dicts
+holding tensors, optimizer state, LR schedulers).
+
+jax arrays are converted to numpy on save (gathering across devices if
+sharded) and come back as numpy; callers re-place them on devices/meshes
+(``set_state_dict`` / ``shard_tensor``).  For topology-aware sharded
+checkpoints with reshard-on-load use ``paddle_tpu.distributed.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_host(obj):
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    return obj
+
+
+def save(obj: Any, path: str) -> None:
+    """Pickle ``obj`` to ``path``; jax arrays become numpy (parity:
+    ``paddle.save``)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    host = jax.tree.map(_to_host, obj)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(host, f, protocol=_PROTOCOL)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+
+
+def load(path: str) -> Any:
+    """Load a ``save``d object (parity: ``paddle.load``)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,26 @@
+"""paddle_tpu.io — datasets and the input pipeline.
+
+TPU-native equivalent of the reference's ``paddle.io`` (upstream layout:
+python/paddle/io/dataloader/ — Dataset, IterableDataset, TensorDataset,
+Sampler/BatchSampler/DistributedBatchSampler, DataLoader with multiprocess
+workers + pinned-memory queues).
+
+Design notes: the reference's worker subprocesses exist to hide Python+CPU
+decode latency behind GPU compute; on TPU the same role is played by a
+**background prefetch thread that stages the next batches into device memory
+with their target sharding** (host→HBM transfer overlaps the current step's
+compute because device execution is async).  ``num_workers`` maps onto a
+thread pool for the per-sample ``__getitem__`` calls (numpy releases the
+GIL), keeping the reference's knob meaningful without fork overhead.
+"""
+
+from .dataloader import (BatchSampler, DataLoader, Dataset,
+                         DistributedBatchSampler, IterableDataset,
+                         RandomSampler, Sampler, SequenceSampler,
+                         TensorDataset, default_collate_fn)
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "Sampler",
+    "SequenceSampler", "RandomSampler", "BatchSampler",
+    "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+]

@@ -1,0 +1,310 @@
+"""Datasets, samplers and the prefetching DataLoader.
+
+Parity targets (upstream layout): python/paddle/io/dataloader/dataset.py,
+sampler.py, batch_sampler.py, dataloader_iter.py, worker.py.  See package
+docstring for the TPU-first redesign rationale.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "Sampler",
+    "SequenceSampler", "RandomSampler", "BatchSampler",
+    "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+]
+
+
+class Dataset:
+    """Map-style dataset (parity: ``paddle.io.Dataset``)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    """Stream-style dataset (parity: ``paddle.io.IterableDataset``)."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset is not indexable")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no length")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        lens = {len(t) for t in tensors}
+        if len(lens) != 1:
+            raise ValueError("all tensors must share dim 0")
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement: bool = False,
+                 num_samples: Optional[int] = None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+        self.generator = generator or np.random.default_rng()
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(self.generator.integers(0, n, self.num_samples)
+                        .tolist())
+        perm = self.generator.permutation(n)[:self.num_samples]
+        return iter(perm.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Groups sampler indices into batches (parity: paddle.io.BatchSampler)."""
+
+    def __init__(self, dataset=None, sampler: Optional[Sampler] = None,
+                 shuffle: bool = False, batch_size: int = 1,
+                 drop_last: bool = False):
+        super().__init__(dataset)
+        if sampler is None:
+            sampler = (RandomSampler(dataset) if shuffle
+                       else SequenceSampler(dataset))
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def _chunk(self, indices: Iterable[int]) -> Iterator[List[int]]:
+        """The one batching loop (drop_last tail rule lives only here)."""
+        batch: List[int] = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __iter__(self):
+        return self._chunk(self.sampler)
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Rank-sliced batches (parity: paddle.io.DistributedBatchSampler).
+
+    On TPU the common path feeds *global* batches (shard_batch lays them over
+    the dp axes), so num_replicas defaults to 1; multi-host pipelines pass
+    ``jax.process_count()/process_index()`` to read disjoint data per host.
+    """
+
+    def __init__(self, dataset, batch_size: int, num_replicas: Optional[int]
+                 = None, rank: Optional[int] = None, shuffle: bool = False,
+                 drop_last: bool = False, seed: int = 0):
+        import jax
+        self.num_replicas = (num_replicas if num_replicas is not None
+                             else jax.process_count())
+        self.rank = rank if rank is not None else jax.process_index()
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        super().__init__(dataset, sampler=None, shuffle=False,
+                         batch_size=batch_size, drop_last=drop_last)
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _indices(self) -> List[int]:
+        n = len(self.data_source)
+        idx = list(range(n))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(n).tolist()
+        # pad to a multiple of replicas (the reference wraps around)
+        pad = (-len(idx)) % self.num_replicas
+        idx += idx[:pad]
+        return idx[self.rank::self.num_replicas]
+
+    def __iter__(self):
+        return self._chunk(self._indices())
+
+    def __len__(self):
+        n = (len(self.data_source) + self.num_replicas - 1) \
+            // self.num_replicas
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack a list of samples (parity: the reference's default_collate_fn)."""
+    first = batch[0]
+    if isinstance(first, (np.ndarray, np.generic)) or hasattr(first, "shape"):
+        return np.stack([np.asarray(b) for b in batch])
+    if isinstance(first, (int, float, bool)):
+        return np.asarray(batch)
+    if isinstance(first, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate_fn(list(col))
+                           for col in zip(*batch))
+    return batch
+
+
+class DataLoader:
+    """Batched, optionally device-prefetching loader
+    (parity: ``paddle.io.DataLoader``).
+
+    ``places``/pin-memory parity: pass ``sharding=`` (a
+    ``jax.sharding.Sharding`` or a ``PartitionSpec`` resolved against the
+    global mesh) to stage batches into device memory with that layout,
+    ``prefetch_factor`` batches ahead, on a background thread.
+    """
+
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = 1,
+                 shuffle: bool = False, sampler=None, batch_sampler=None,
+                 num_workers: int = 0, collate_fn: Optional[Callable] = None,
+                 drop_last: bool = False, prefetch_factor: int = 2,
+                 sharding=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(1, prefetch_factor)
+        self.sharding = sharding
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, sampler=sampler, shuffle=shuffle,
+                batch_size=batch_size or 1, drop_last=drop_last)
+        self._pool = (ThreadPoolExecutor(num_workers)
+                      if num_workers > 0 else None)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+    def _host_batches(self) -> Iterator[Any]:
+        if self._iterable:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if self.batch_size and len(buf) == self.batch_size:
+                    yield self.collate_fn(buf)
+                    buf = []
+            if buf and not self.drop_last:
+                yield self.collate_fn(buf)
+            return
+        for idxs in self.batch_sampler:
+            if self._pool is not None:
+                samples = list(self._pool.map(self.dataset.__getitem__, idxs))
+            else:
+                samples = [self.dataset[i] for i in idxs]
+            yield self.collate_fn(samples)
+
+    def _device_put(self, batch):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = self.sharding
+        if isinstance(sh, PartitionSpec):
+            from ..distributed import env
+            hcg = env.hybrid_group()
+            if hcg is None:
+                raise RuntimeError("PartitionSpec sharding needs "
+                                   "init_parallel_env()")
+            sh = NamedSharding(hcg.mesh, sh)
+
+        def put(v):
+            if sh is None:
+                return jax.device_put(v)
+            spec = PartitionSpec(*tuple(sh.spec)[:np.ndim(v)]) \
+                if isinstance(sh, NamedSharding) else None
+            tgt = NamedSharding(sh.mesh, spec) if spec is not None else sh
+            return jax.device_put(v, tgt)
+
+        return jax.tree.map(put, batch)
+
+    def __iter__(self):
+        if self.sharding is None and self.prefetch_factor <= 1:
+            yield from self._host_batches()
+            return
+        # background prefetch: stage up to prefetch_factor batches ahead
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        stop = threading.Event()
+        END, ERR = object(), object()
+
+        def producer():
+            try:
+                for b in self._host_batches():
+                    if stop.is_set():
+                        return
+                    q.put(self._device_put(b) if self.sharding is not None
+                          else b)
+                q.put(END)
+            except BaseException as e:  # surfaced in the consumer
+                q.put((ERR, e))
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is ERR:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

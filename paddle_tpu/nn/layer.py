@@ -25,6 +25,7 @@ Design for jax:
 from __future__ import annotations
 
 import collections
+import contextlib
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -34,7 +35,8 @@ import numpy as np
 from ..framework import dtype as _dtype_mod
 from ..framework import random as _random
 
-__all__ = ["Parameter", "Layer", "Sequential", "LayerList", "functional_call"]
+__all__ = ["Parameter", "Layer", "Sequential", "LayerList",
+           "functional_call", "bind_params"]
 
 
 class Parameter:
@@ -363,6 +365,39 @@ class LayerList(Layer):
         raise NotImplementedError("LayerList is a container; index into it")
 
 
+@contextlib.contextmanager
+def bind_params(model: Layer, state: Dict[str, Any], rng=None,
+                eval_mode: bool = False):
+    """Temporarily rebind a pytree of parameter values onto the live module.
+
+    The single functional bridge every jit/grad entry point goes through
+    (functional_call, the train/eval step builders, the driver hooks):
+    values are restored on exit even on exception, so tracing never leaks
+    tracers into the module.  ``rng`` pins the RNG key for stochastic layers;
+    ``eval_mode`` traces with ``training=False`` (restored after).
+    """
+    handles = dict(model.named_parameters(include_buffers=True))
+    old = {}
+    was_training = model.training
+    try:
+        for k, v in state.items():
+            h = handles[k]
+            old[k] = h.value
+            h.value = v
+        if eval_mode:
+            model.eval()
+        if rng is not None:
+            with _random.rng_guard(rng):
+                yield model
+        else:
+            yield model
+    finally:
+        if eval_mode and was_training:
+            model.train()
+        for k, v in old.items():
+            handles[k].value = v
+
+
 def functional_call(model: Layer, state: Dict[str, Any], *args,
                     rng=None, **kwargs):
     """Run ``model(*args, **kwargs)`` with parameter values taken from ``state``.
@@ -374,17 +409,5 @@ def functional_call(model: Layer, state: Dict[str, Any], *args,
     pins the RNG key for stochastic layers (dropout) via
     :func:`paddle_tpu.framework.random.rng_guard`.
     """
-    handles = dict(model.named_parameters(include_buffers=True))
-    old = {}
-    try:
-        for k, v in state.items():
-            h = handles[k]
-            old[k] = h.value
-            h.value = v
-        if rng is not None:
-            with _random.rng_guard(rng):
-                return model(*args, **kwargs)
+    with bind_params(model, state, rng=rng):
         return model(*args, **kwargs)
-    finally:
-        for k, v in old.items():
-            handles[k].value = v

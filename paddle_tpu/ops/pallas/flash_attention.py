@@ -163,7 +163,7 @@ def _fwd(q, k, v, scale: float, causal: bool, interpret: bool = False):
                                  "arbitrary")),
         interpret=interpret,
     )(q, k, v)
-    return out, lse[..., 0]
+    return out, lse  # lse lane-broadcast (b, hq, sq, _LANES); callers slice
 
 
 # ---------------------------------------------------------------------------
@@ -262,7 +262,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(scale, causal, interpret, res, grads):
-    q, k, v, out, lse = res
+    q, k, v, out, lse4 = res  # lse4: lane-broadcast residual from _fwd
     do, dlse = grads
     do = do.astype(q.dtype)
     b, hq, sq, d = q.shape
@@ -275,8 +275,8 @@ def _bwd(scale, causal, interpret, res, grads):
     # the lse cotangent folds into the ds formula exactly:
     #   ds = p*(dp - delta)*scale + p*dlse*scale = p*(dp - (delta-dlse))*scale
     delta = delta - dlse.astype(jnp.float32)
-    # lane-broadcast lse/delta for TPU block tiling (last dim = _LANES)
-    lse4 = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANES))
+    # lane-broadcast for TPU block tiling (last dim = _LANES); lse stays in
+    # its broadcast layout from the forward — no slice/re-broadcast round trip
     delta4 = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
 
     dq_kernel = functools.partial(
@@ -353,12 +353,13 @@ def _bwd(scale, causal, interpret, res, grads):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, scale, causal, interpret):
-    return _fwd(q, k, v, scale, causal, interpret)
+    out, lse4 = _fwd(q, k, v, scale, causal, interpret)
+    return out, lse4[..., 0]
 
 
 def _flash_fwd(q, k, v, scale, causal, interpret):
-    out, lse = _fwd(q, k, v, scale, causal, interpret)
-    return (out, lse), (q, k, v, out, lse)
+    out, lse4 = _fwd(q, k, v, scale, causal, interpret)
+    return (out, lse4[..., 0]), (q, k, v, out, lse4)
 
 
 _flash.defvjp(_flash_fwd, _bwd)

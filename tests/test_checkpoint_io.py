@@ -1,0 +1,211 @@
+"""Checkpoint (core + distributed reshard-on-load) and DataLoader tests.
+
+Patterns per SURVEY.md §4/§5: save on one topology, load on another, values
+equal; DataLoader batches vs hand-rolled oracle.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           TensorDataset)
+
+
+# -- paddle.save / paddle.load ----------------------------------------------
+
+def test_save_load_roundtrip(tmp_path):
+    obj = {"w": jnp.arange(6.0).reshape(2, 3), "step": 7,
+           "nested": {"b": jnp.ones((3,), jnp.bfloat16)}}
+    p = str(tmp_path / "ck" / "model.pdparams")
+    pt.save(obj, p)
+    back = pt.load(p)
+    np.testing.assert_allclose(back["w"], np.arange(6.0).reshape(2, 3))
+    assert back["step"] == 7
+    assert back["nested"]["b"].dtype == jnp.bfloat16
+
+
+def test_save_load_model_state(tmp_path):
+    from paddle_tpu.nn import Linear
+    pt.seed(0)
+    m = Linear(4, 3)
+    p = str(tmp_path / "lin.pdparams")
+    pt.save(m.state_dict(), p)
+    pt.seed(1)
+    m2 = Linear(4, 3)
+    m2.set_state_dict(pt.load(p))
+    x = jnp.ones((2, 4))
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)))
+
+
+# -- distributed checkpoint: shard + reshard on load -------------------------
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_dist_checkpoint_reshard(tmp_path):
+    path = str(tmp_path / "dck")
+    m_a = _mesh((2, 4), ("x", "y"))
+    state = {
+        "w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                            NamedSharding(m_a, P("x", "y"))),
+        "opt": {"m": jax.device_put(jnp.arange(16.0),
+                                    NamedSharding(m_a, P("y")))},
+        "step": jnp.asarray(3),
+    }
+    dist.save_state_dict(state, path)
+
+    # load onto a different topology
+    m_b = _mesh((4, 2), ("a", "b"))
+    shardings = {"w": NamedSharding(m_b, P("b", "a")),
+                 "opt/m": NamedSharding(m_b, P("a")),
+                 "step": NamedSharding(m_b, P())}
+    back = dist.load_state_dict(path, shardings=shardings)
+    np.testing.assert_allclose(np.asarray(back["w"]),
+                               np.arange(64.0).reshape(8, 8))
+    np.testing.assert_allclose(np.asarray(back["opt"]["m"]),
+                               np.arange(16.0))
+    assert int(back["step"]) == 3
+    assert back["w"].sharding.spec == P("b", "a")
+
+
+def test_dist_checkpoint_load_to_host(tmp_path):
+    path = str(tmp_path / "dck2")
+    m_a = _mesh((8,), ("x",))
+    state = {"w": jax.device_put(jnp.arange(24.0).reshape(8, 3),
+                                 NamedSharding(m_a, P("x")))}
+    dist.save_state_dict(state, path)
+    back = dist.load_state_dict(path)  # plain numpy
+    np.testing.assert_allclose(back["w"], np.arange(24.0).reshape(8, 3))
+
+
+def test_dist_checkpoint_bfloat16_roundtrip(tmp_path):
+    """bf16 (ml_dtypes) must survive the .npy round trip — the flagship
+    model checkpoints are bf16."""
+    path = str(tmp_path / "dck_bf16")
+    m_a = _mesh((2, 4), ("x", "y"))
+    w = jax.device_put(jnp.arange(32.0, dtype=jnp.bfloat16).reshape(8, 4),
+                       NamedSharding(m_a, P("x", "y")))
+    dist.save_state_dict({"w": w}, path)
+    back = dist.load_state_dict(path)
+    assert back["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(back["w"], np.float32),
+                               np.arange(32.0).reshape(8, 4))
+    # and onto a mesh
+    back2 = dist.load_state_dict(
+        path, shardings={"w": NamedSharding(m_a, P("y"))})
+    assert back2["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(back2["w"], np.float32),
+                               np.arange(32.0).reshape(8, 4))
+
+
+def test_dist_checkpoint_async(tmp_path):
+    path = str(tmp_path / "dck3")
+    h = dist.save_state_dict({"w": jnp.ones((4, 4))}, path, blocking=False)
+    h.wait()
+    back = dist.load_state_dict(path)
+    np.testing.assert_allclose(back["w"], np.ones((4, 4)))
+
+
+def test_dist_checkpoint_template_load(tmp_path):
+    path = str(tmp_path / "dck4")
+    m_a = _mesh((2, 4), ("x", "y"))
+    w = jax.device_put(jnp.arange(32.0).reshape(8, 4),
+                       NamedSharding(m_a, P("x")))
+    dist.save_state_dict({"w": w}, path)
+    tmpl = {"w": jax.device_put(jnp.zeros((8, 4)),
+                                NamedSharding(m_a, P(None, "y")))}
+    back = dist.load_state_dict(path, template=tmpl)
+    assert back["w"].sharding.spec == P(None, "y")
+    np.testing.assert_allclose(np.asarray(back["w"]),
+                               np.arange(32.0).reshape(8, 4))
+
+
+# -- DataLoader --------------------------------------------------------------
+
+class _Sq(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return {"x": np.full((3,), i, np.float32), "y": np.int64(i)}
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_basic():
+    dl = DataLoader(_Sq(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0]["x"].shape == (4, 3)
+    np.testing.assert_allclose(batches[0]["y"], [0, 1, 2, 3])
+    assert batches[2]["x"].shape == (2, 3)  # remainder kept
+
+
+def test_dataloader_drop_last_shuffle():
+    dl = DataLoader(_Sq(10), batch_size=4, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    seen = np.concatenate([b["y"] for b in batches])
+    assert len(set(seen.tolist())) == 8  # distinct samples
+
+
+def test_dataloader_workers_match_serial():
+    a = [b["y"].tolist() for b in DataLoader(_Sq(9), batch_size=3)]
+    b = [b["y"].tolist() for b in DataLoader(_Sq(9), batch_size=3,
+                                             num_workers=4)]
+    assert a == b
+
+
+def test_dataloader_tensor_dataset():
+    xs = np.arange(12).reshape(6, 2)
+    ys = np.arange(6)
+    dl = DataLoader(TensorDataset([xs, ys]), batch_size=3)
+    xb, yb = next(iter(dl))
+    np.testing.assert_allclose(xb, xs[:3])
+    np.testing.assert_allclose(yb, ys[:3])
+
+
+def test_dataloader_iterable():
+    class It(IterableDataset):
+        def __iter__(self):
+            yield from (np.float32(i) for i in range(7))
+
+    dl = DataLoader(It(), batch_size=3)
+    shapes = [b.shape for b in dl]
+    assert shapes == [(3,), (3,), (1,)]
+
+
+def test_dataloader_device_prefetch():
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, mp_degree=4)
+    dist.set_hybrid_group(hcg)
+    try:
+        dl = DataLoader(_Sq(8), batch_size=8, sharding=P(("dp", "sharding")))
+        b = next(iter(dl))
+        assert isinstance(b["x"], jax.Array)
+        assert b["x"].sharding.spec == P(("dp", "sharding"))
+    finally:
+        dist.set_hybrid_group(None)
+
+
+def test_distributed_batch_sampler_partition():
+    ds = _Sq(12)
+    parts = []
+    for r in range(3):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=3, rank=r)
+        parts.append([i for b in s for i in b])
+    assert sorted(sum(parts, [])) == list(range(12))
+    assert all(len(p) == 4 for p in parts)
+
+
+def test_batch_sampler_len():
+    assert len(BatchSampler(_Sq(10), batch_size=4)) == 3
+    assert len(BatchSampler(_Sq(10), batch_size=4, drop_last=True)) == 2
