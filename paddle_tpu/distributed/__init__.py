@@ -25,9 +25,13 @@ from .topology import (AXIS_ORDER, CommunicateTopology,
                        HybridCommunicateGroup, ParallelMode)
 from . import checkpoint, fleet
 from .checkpoint import load_state_dict, save_state_dict
+from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,
+                       SharedLayerDesc)
 
 __all__ = [
     "checkpoint", "save_state_dict", "load_state_dict",
+    # pipeline
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     # auto-parallel
     "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer",

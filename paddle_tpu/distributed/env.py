@@ -27,6 +27,32 @@ __all__ = [
 
 _HCG: Optional[HybridCommunicateGroup] = None
 _MULTIHOST_INITIALIZED = False
+_ACTIVE_MESH = None  # sub-mesh override (pipeline stages)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    """Temporarily override the mesh that sharding constraints resolve
+    against — pipeline stages trace their programs over a pp-less sub-mesh
+    while the global topology still has the pp axis."""
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH = prev
+
+
+def active_mesh():
+    """The mesh for sharding constraints: the use_mesh override, else the
+    global hybrid mesh, else None."""
+    if _ACTIVE_MESH is not None:
+        return _ACTIVE_MESH
+    return _HCG.mesh if _HCG is not None else None
 
 
 def init_parallel_env(dp_degree: Optional[int] = None, mp_degree: int = 1,

@@ -43,13 +43,25 @@ __all__ = [
 
 
 def constrain(x, *spec_entries):
-    """Apply a sharding constraint when a global mesh is installed; no-op
-    otherwise (keeps layers runnable outside any parallel context)."""
-    hcg = env.hybrid_group()
-    if hcg is None:
+    """Apply a sharding constraint when a mesh is active; no-op otherwise
+    (keeps layers runnable outside any parallel context).  Resolves against
+    ``env.active_mesh()`` so pipeline stages constrain over their sub-mesh;
+    spec axes the mesh doesn't have are dropped (e.g. ``pp``-less stages)."""
+    mesh = env.active_mesh()
+    if mesh is None:
         return x
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(hcg.mesh, P(*spec_entries)))
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    spec = P(*(keep(e) for e in spec_entries))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 class ColumnParallelLinear(Layer):

@@ -7,9 +7,10 @@ parallel stack.
 """
 
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
-                    llama3_8b_config, tiny_llama_config)
+                    causal_lm_loss, llama3_8b_config, llama_pipe_descs,
+                    tiny_llama_config)
 
 __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama3_8b_config",
-    "tiny_llama_config",
+    "tiny_llama_config", "llama_pipe_descs", "causal_lm_loss",
 ]

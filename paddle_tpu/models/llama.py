@@ -213,6 +213,21 @@ class LlamaModel(Layer):
         return self.norm(x)
 
 
+def causal_lm_loss(logits, labels):
+    """Mean next-token cross entropy in fp32 over (possibly vocab-sharded)
+    logits — the ParallelCrossEntropy dataflow: no logits all-gather."""
+    logits = constrain(logits, ("dp", "sharding"), "sep", "mp")
+    logits = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+    gold = jnp.take_along_axis(
+        shifted, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+    loss = lse - gold
+    valid = (labels >= 0).astype(jnp.float32)
+    return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
 class LlamaForCausalLM(Layer):
     """Causal LM head + loss (the train-step entry the benchmarks drive)."""
 
@@ -236,16 +251,73 @@ class LlamaForCausalLM(Layer):
         return self.logits(self.model(input_ids, position_ids))
 
     def compute_loss(self, input_ids, labels, position_ids=None):
-        """Mean next-token cross entropy in fp32 over vocab-sharded logits
-        (the ParallelCrossEntropy dataflow: no logits all-gather)."""
-        logits = self.forward(input_ids, position_ids)
-        logits = constrain(logits, ("dp", "sharding"), "sep", "mp")
-        logits = logits.astype(jnp.float32)
-        m = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
-        shifted = logits - m
-        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
-        gold = jnp.take_along_axis(
-            shifted, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
-        loss = lse - gold
-        valid = (labels >= 0).astype(jnp.float32)
-        return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        return causal_lm_loss(self.forward(input_ids, position_ids), labels)
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel form: the same model as a flat list of LayerDescs
+# (parity: PaddleNLP's LlamaForCausalLMPipe built on fleet's PipelineLayer)
+# ---------------------------------------------------------------------------
+
+class LlamaEmbeddingPipe(Layer):
+    """Stage-0 piece: token embedding (vocab-parallel)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.embed_tokens = self.create_parameter(
+            (config.vocab_size, config.hidden_size), dtype=config.dtype,
+            initializer=I.Normal(std=config.initializer_range),
+            sharding=P("mp", "sharding"), attr_name="embed_tokens")
+
+    def forward(self, input_ids):
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        return constrain(x, *_batch_spec(x.ndim))
+
+
+class LlamaDecoderLayerPipe(LlamaDecoderLayer):
+    """Decoder block carrying its own (deterministic) RoPE buffers, so any
+    stage can host it without cross-stage buffer plumbing."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(config)
+        cos, sin = build_rope_cache(config.max_position_embeddings,
+                                    config.head_dim, base=config.rope_theta)
+        self.register_buffer("rope_cos", cos)
+        self.register_buffer("rope_sin", sin)
+        self._recompute = config.recompute
+
+    def forward(self, x):
+        rope = (self.rope_cos, self.rope_sin)
+        if self._recompute and self.training:
+            return jax.checkpoint(
+                lambda h: super(LlamaDecoderLayerPipe, self).forward(
+                    h, rope))(x)
+        return super().forward(x, rope)
+
+
+class LlamaHeadPipe(Layer):
+    """Last-stage piece: final norm + LM head → logits."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps,
+                            dtype=config.dtype)
+        self.lm_head = self.create_parameter(
+            (config.hidden_size, config.vocab_size), dtype=config.dtype,
+            initializer=I.Normal(std=config.initializer_range),
+            sharding=P("sharding", "mp"), attr_name="lm_head")
+
+    def forward(self, x):
+        return self.norm(x) @ self.lm_head
+
+
+def llama_pipe_descs(config: LlamaConfig):
+    """(layer_descs, loss_fn) for PipelineLayer — same parameter-creation
+    order as LlamaForCausalLM, so identical seeds give identical weights."""
+    from ..distributed.pipeline import LayerDesc
+
+    descs = [LayerDesc(LlamaEmbeddingPipe, config)]
+    descs += [LayerDesc(LlamaDecoderLayerPipe, config)
+              for _ in range(config.num_hidden_layers)]
+    descs.append(LayerDesc(LlamaHeadPipe, config))
+    return descs, causal_lm_loss
