@@ -25,6 +25,9 @@ from .topology import (AXIS_ORDER, CommunicateTopology,
                        HybridCommunicateGroup, ParallelMode)
 from . import checkpoint, fleet
 from .checkpoint import load_state_dict, save_state_dict
+from . import moe
+from .context_parallel import context_parallel_attention
+from .moe import GShardGate, MoELayer, SwitchGate
 from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,
                        SharedLayerDesc)
 
@@ -32,6 +35,10 @@ __all__ = [
     "checkpoint", "save_state_dict", "load_state_dict",
     # pipeline
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    # context parallel
+    "context_parallel_attention",
+    # moe
+    "moe", "MoELayer", "GShardGate", "SwitchGate",
     # auto-parallel
     "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer",

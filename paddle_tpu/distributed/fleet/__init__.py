@@ -21,6 +21,11 @@ from .. import env
 from ..topology import HybridCommunicateGroup
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
+from .sequence_parallel_utils import (AllGatherOp, ColumnSequenceParallelLinear,
+                                      GatherOp, ReduceScatterOp,
+                                      RowSequenceParallelLinear, ScatterOp,
+                                      mark_as_sequence_parallel_parameter,
+                                      register_sequence_parallel_allreduce_hooks)
 from .strategy import DistributedStrategy
 
 __all__ = [
@@ -28,6 +33,10 @@ __all__ = [
     "distributed_model", "distributed_optimizer", "DistributedStrategy",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "worker_index", "worker_num",
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
 ]
 
 _strategy: Optional[DistributedStrategy] = None

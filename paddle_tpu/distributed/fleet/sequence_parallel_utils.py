@@ -1,0 +1,86 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity with the reference's fleet/utils/sequence_parallel_utils.py
+(``ScatterOp``, ``GatherOp``, ``AllGatherOp``, ``ReduceScatterOp``,
+``ColumnSequenceParallelLinear``, ``RowSequenceParallelLinear``,
+``mark_as_sequence_parallel_parameter``,
+``register_sequence_parallel_allreduce_hooks``).
+
+Megatron-SP shards the *sequence* dim of activations over the TP (``mp``)
+axis between transformer blocks, so the norm/dropout/residual work is
+divided P-ways; an all-gather precedes each column-parallel matmul and a
+reduce-scatter follows each row-parallel one.  Under GSPMD all four ops are
+sharding constraints — XLA materialises exactly that all-gather /
+reduce-scatter pair, and the "allreduce hooks" for norm parameters are
+subsumed by gradient psums the partitioner already inserts.  The classes
+below keep the reference's call-site API.
+"""
+
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from .mp_layers import ColumnParallelLinear, RowParallelLinear, constrain
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _seq_dim(x, axis: int = 1) -> int:
+    return axis if x.ndim > axis else 0
+
+
+class ScatterOp:
+    """Split the seq dim over mp (parity: ScatterOp.apply)."""
+
+    @staticmethod
+    def apply(x, axis: int = 1):
+        spec = [None] * x.ndim
+        spec[_seq_dim(x, axis)] = "mp"
+        return constrain(x, *spec)
+
+
+class GatherOp:
+    """Re-replicate the seq dim (parity: GatherOp.apply)."""
+
+    @staticmethod
+    def apply(x, axis: int = 1):
+        return constrain(x, *([None] * x.ndim))
+
+
+AllGatherOp = GatherOp           # reference aliases (fwd allgather)
+ReduceScatterOp = ScatterOp      # fwd reduce-scatter ≙ scatter constraint
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear fed by seq-sharded activations: the input is
+    gathered over mp (XLA inserts the all-gather) and the output keeps the
+    mp-sharded feature dim."""
+
+    def forward(self, x):
+        x = GatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output is scattered back onto the seq dim
+    (XLA lowers the psum+split to one reduce-scatter)."""
+
+    def forward(self, x):
+        y = super().forward(x)
+        return ScatterOp.apply(y)
+
+
+def mark_as_sequence_parallel_parameter(param) -> None:
+    """API parity no-op: under GSPMD the partitioner already psums these
+    gradients across mp; kept so reference call sites port unchanged."""
+    return None
+
+
+def register_sequence_parallel_allreduce_hooks(model: Layer, *args,
+                                               **kwargs) -> None:
+    """API parity no-op (see mark_as_sequence_parallel_parameter)."""
+    return None

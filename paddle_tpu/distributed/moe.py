@@ -1,0 +1,196 @@
+"""Mixture-of-Experts with expert parallelism.
+
+TPU-native equivalent of the reference's MoE stack (upstream layout:
+python/paddle/incubate/distributed/models/moe/ — ``MoELayer``, gates in
+gate/ (``GShardGate``, ``SwitchGate``, ``NaiveGate``), dispatch via the
+global_scatter/global_gather alltoall ops in
+paddle/fluid/operators/collective/).
+
+Design: the GShard/Switch capacity formulation as dense einsums — the
+canonical TPU MoE (GShard paper):
+
+  * gate: softmax router; top-k choice; per-expert **capacity**
+    C = ceil(capacity_factor * tokens * k / E); tokens over capacity are
+    dropped (contribute zero, like the reference's drop policy);
+  * dispatch: one-hot (tokens, E, C) mask → ``einsum`` gather into
+    (E, C, D) expert batches; combine: weighted scatter back;
+  * experts: **stacked** parameters with a leading expert dim sharded over
+    the EP mesh axes (dp×sharding — the reference derives its MoE group the
+    same way); XLA lowers the dispatch/combine einsums to the exact
+    all_to_all pair the reference codes as global_scatter/global_gather;
+  * aux losses in fp32: GShard load-balancing loss and the router z-loss.
+
+Everything is jit-traceable — static shapes, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from .fleet.mp_layers import constrain
+
+__all__ = ["Gate", "SwitchGate", "GShardGate", "MoELayer"]
+
+EP_AXES = ("dp", "sharding")  # expert dim rides the combined dp×sharding axes
+
+
+class Gate(Layer):
+    """Router base (parity: BaseGate).  Subclasses set ``top_k``."""
+
+    top_k = 1
+
+    def __init__(self, hidden_size: int, num_experts: int, dtype=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.weight = self.create_parameter(
+            (hidden_size, num_experts), dtype=dtype,
+            initializer=I.Normal(std=0.02), attr_name="weight")
+
+    def logits(self, x):
+        # router math in fp32 (the reference's gate casts up too)
+        return (x.astype(jnp.float32) @ self.weight.astype(jnp.float32))
+
+
+class SwitchGate(Gate):
+    """Top-1 routing (parity: SwitchGate; Switch Transformer)."""
+
+    top_k = 1
+
+
+class GShardGate(Gate):
+    """Top-2 routing (parity: GShardGate)."""
+
+    top_k = 2
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+class MoELayer(Layer):
+    """Expert-parallel MoE block (parity: MoELayer).
+
+    ``expert_fn(params_pytree, x)`` applies ONE expert; parameters are
+    created stacked (leading dim = num_experts) via ``expert_param_specs``.
+    The default expert is the SwiGLU FFN (LlamaMLP shape).
+
+    Returns ``(out, aux_loss)``; ``aux_loss`` = load-balance + z-loss,
+    already scaled by their coefficients.
+    """
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, gate: Optional[Gate] = None,
+                 top_k: Optional[int] = None,
+                 capacity_factor: float = 1.25,
+                 eval_capacity_factor: Optional[float] = None,
+                 aux_loss_coef: float = 0.01, z_loss_coef: float = 1e-3,
+                 dtype=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.gate = gate if gate is not None else GShardGate(
+            hidden_size, num_experts, dtype=dtype)
+        self.top_k = top_k if top_k is not None else type(self.gate).top_k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = (eval_capacity_factor
+                                     if eval_capacity_factor is not None
+                                     else capacity_factor)
+        self.aux_loss_coef = aux_loss_coef
+        self.z_loss_coef = z_loss_coef
+        e = num_experts
+        init = I.Normal(std=0.02)
+        # stacked SwiGLU experts, expert dim on the EP axes
+        self.gate_proj = self.create_parameter(
+            (e, hidden_size, intermediate_size), dtype=dtype,
+            initializer=init, sharding=P(EP_AXES), attr_name="gate_proj")
+        self.up_proj = self.create_parameter(
+            (e, hidden_size, intermediate_size), dtype=dtype,
+            initializer=init, sharding=P(EP_AXES), attr_name="up_proj")
+        self.down_proj = self.create_parameter(
+            (e, intermediate_size, hidden_size), dtype=dtype,
+            initializer=init, sharding=P(EP_AXES), attr_name="down_proj")
+
+    # -- routing ------------------------------------------------------------
+
+    def _capacity(self, tokens: int) -> int:
+        f = (self.capacity_factor if self.training
+             else self.eval_capacity_factor)
+        return max(4, int(math.ceil(tokens * self.top_k * f
+                                    / self.num_experts)))
+
+    def _route(self, logits):
+        """(T, E) logits → dispatch (T, E, C), combine (T, E, C), aux."""
+        t, e = logits.shape
+        c = self._capacity(t)
+        probs = jax.nn.softmax(logits, axis=-1)          # (T, E) fp32
+
+        gates_list = []
+        masks = []
+        remaining = probs
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)          # (T,)
+            mask = _one_hot(idx, e)                       # (T, E)
+            gates_list.append((probs * mask).sum(-1))     # (T,)
+            masks.append(mask)
+            remaining = remaining * (1.0 - mask)
+
+        # position within each expert's buffer, first-come-first-served in
+        # token order, counting all k choices in priority order
+        disp = jnp.zeros((t, e, c), jnp.float32)
+        combine = jnp.zeros((t, e, c), jnp.float32)
+        prior = jnp.zeros((t, e), jnp.float32)
+        for k in range(self.top_k):
+            mask = masks[k]
+            pos = (jnp.cumsum(mask, axis=0) - mask) + prior  # (T, E)
+            prior = prior + mask.sum(0, keepdims=True)
+            keep = (pos < c) * mask                        # under capacity
+            pos_oh = _one_hot(jnp.sum(pos * mask, -1).astype(jnp.int32), c)
+            d_k = keep[:, :, None] * pos_oh[:, None, :]    # (T, E, C)
+            disp = disp + d_k
+            combine = combine + d_k * gates_list[k][:, None, None]
+
+        if self.top_k > 1:
+            # normalise combine weights over the kept choices (GShard renorm)
+            denom = combine.sum(axis=(1, 2), keepdims=True)
+            combine = combine / jnp.maximum(denom, 1e-9)
+        # top-1 keeps the raw gate probability (Switch Transformer): scaling
+        # by p is what keeps the router differentiable through the task loss
+
+        # aux losses (fp32): GShard load-balance + z-loss
+        me = probs.mean(axis=0)                            # (E,)
+        ce = masks[0].mean(axis=0)                         # top-1 fraction
+        l_aux = (me * ce).sum() * e * self.aux_loss_coef
+        l_z = (jax.nn.logsumexp(logits, axis=-1) ** 2).mean() \
+            * self.z_loss_coef
+        return disp, combine, l_aux + l_z
+
+    # -- forward ------------------------------------------------------------
+
+    def _expert(self, x):
+        """Apply all experts: x (E, C, D) → (E, C, D)."""
+        g = jnp.einsum("ecd,edf->ecf", x, self.gate_proj)
+        u = jnp.einsum("ecd,edf->ecf", x, self.up_proj)
+        return jnp.einsum("ecf,efd->ecd", F.swiglu(g, u), self.down_proj)
+
+    def forward(self, x):
+        """x: (..., D) → (out (..., D), aux_loss scalar)."""
+        shape = x.shape
+        xt = x.reshape(-1, shape[-1])                      # (T, D)
+        logits = self.gate.logits(xt)                      # (T, E) fp32
+        disp, combine, aux = self._route(logits)
+        # dispatch: (T,E,C) × (T,D) → (E,C,D); XLA emits the alltoall when
+        # T is batch-sharded and E is expert-sharded
+        xe = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), xt)
+        xe = constrain(xe, EP_AXES, None, None)
+        ye = self._expert(xe)
+        ye = constrain(ye, EP_AXES, None, None)
+        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)
+        return out.reshape(shape), aux

@@ -57,6 +57,15 @@ class LlamaConfig:
     initializer_range: float = 0.02
     dtype: str = "float32"
     recompute: bool = False
+    # context parallelism over the sep axis: "ring" | "ulysses" | "gspmd"
+    # ("gspmd" = no explicit CP; XLA gathers KV per the sharding constraints)
+    context_parallel: str = "ring"
+
+    def __post_init__(self):
+        if self.context_parallel not in ("ring", "ulysses", "gspmd"):
+            raise ValueError(
+                f"context_parallel must be 'ring', 'ulysses' or 'gspmd', "
+                f"got {self.context_parallel!r}")
 
     @property
     def head_dim(self) -> int:
@@ -127,10 +136,19 @@ class LlamaAttention(Layer):
             v = jnp.concatenate([pv, v], axis=1)
             kv_cache = (k, v)
         # heads on mp, batch on (dp, sharding), seq on sep
-        q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
-        k = constrain(k, ("dp", "sharding"), None, "mp", None)
-        v = constrain(v, ("dp", "sharding"), None, "mp", None)
-        out = flash_attention(q, k, v, causal=True)
+        if kv_cache is None and c.context_parallel in ("ring", "ulysses"):
+            from ..distributed.context_parallel import \
+                context_parallel_attention
+            q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
+            k = constrain(k, ("dp", "sharding"), "sep", "mp", None)
+            v = constrain(v, ("dp", "sharding"), "sep", "mp", None)
+            out = context_parallel_attention(q, k, v, causal=True,
+                                             mode=c.context_parallel)
+        else:
+            q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
+            k = constrain(k, ("dp", "sharding"), None, "mp", None)
+            v = constrain(v, ("dp", "sharding"), None, "mp", None)
+            out = flash_attention(q, k, v, causal=True)
         out = out.reshape(b, s, -1) @ self.o_proj
         if kv_cache is not None:
             return out, kv_cache

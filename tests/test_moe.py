@@ -1,0 +1,145 @@
+"""MoE layer tests: routing/dispatch vs a per-token numpy oracle, capacity
+dropping, aux losses, and sharded-equals-serial (SURVEY.md §4 pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.moe import GShardGate, MoELayer, SwitchGate
+
+
+def _expert_oracle(layer, x_tok, e):
+    """Apply expert e to one token row with numpy."""
+    g = x_tok @ np.asarray(layer.gate_proj)[e]
+    u = x_tok @ np.asarray(layer.up_proj)[e]
+    silu = g / (1.0 + np.exp(-g))
+    return (silu * u) @ np.asarray(layer.down_proj)[e]
+
+
+def _tokens(t, d, seed=0):
+    return np.random.RandomState(seed).randn(t, d).astype(np.float32)
+
+
+def test_switch_top1_matches_oracle():
+    pt.seed(0)
+    layer = MoELayer(16, 32, num_experts=4,
+                     gate=SwitchGate(16, 4), capacity_factor=8.0,
+                     aux_loss_coef=0.0, z_loss_coef=0.0)
+    x = _tokens(12, 16)
+    out, aux = layer(jnp.asarray(x))
+    logits = x @ np.asarray(layer.gate.weight)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros_like(x)
+    for t in range(12):
+        e = int(np.argmax(logits[t]))
+        # Switch semantics: output scaled by the gate probability (keeps the
+        # router differentiable through the task loss)
+        want[t] = probs[t, e] * _expert_oracle(layer, x[t], e)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-4)
+    assert float(aux) == 0.0
+
+
+def test_switch_router_learns_from_task_loss():
+    """Regression: top-1 must NOT renormalise combine weights to 1 — the
+    router gradient through the task loss would vanish."""
+    pt.seed(9)
+    layer = MoELayer(8, 16, num_experts=4, gate=SwitchGate(8, 4),
+                     capacity_factor=8.0, aux_loss_coef=0.0, z_loss_coef=0.0)
+    x = jnp.asarray(_tokens(16, 8, seed=11))
+    from paddle_tpu.nn.layer import bind_params
+    params = layer.trainable_state()
+
+    def task_loss(p):
+        with bind_params(layer, p):
+            out, _ = layer(x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(task_loss)(params)
+    # pre-fix (renorm-to-1) this was ~3e-13 (numerically zero); with Switch
+    # p-scaling it is ~1e-6 at 0.02-std init — orders of magnitude apart
+    assert float(jnp.abs(g["gate.weight"]).sum()) > 1e-7
+
+
+def test_gshard_top2_matches_oracle():
+    pt.seed(1)
+    layer = MoELayer(16, 32, num_experts=4, capacity_factor=8.0,
+                     aux_loss_coef=0.0, z_loss_coef=0.0)
+    assert isinstance(layer.gate, GShardGate) and layer.top_k == 2
+    x = _tokens(10, 16, seed=3)
+    out, _ = layer(jnp.asarray(x))
+    logits = x @ np.asarray(layer.gate.weight)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros_like(x)
+    for t in range(10):
+        order = np.argsort(-probs[t])
+        e1, e2 = int(order[0]), int(order[1])
+        w1, w2 = probs[t, e1], probs[t, e2]
+        w1, w2 = w1 / (w1 + w2), w2 / (w1 + w2)
+        want[t] = w1 * _expert_oracle(layer, x[t], e1) \
+            + w2 * _expert_oracle(layer, x[t], e2)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-4)
+
+
+def test_capacity_dropping():
+    pt.seed(2)
+    # capacity so small that most tokens to the popular expert drop
+    layer = MoELayer(8, 16, num_experts=2, gate=SwitchGate(8, 2),
+                     capacity_factor=0.01, aux_loss_coef=0.0, z_loss_coef=0.0)
+    # force every token to expert 0 by biasing inputs along the gate weight
+    w = np.asarray(layer.gate.weight)
+    x = np.tile(w[:, 0] * 5, (16, 1)).astype(np.float32)
+    out, _ = layer(jnp.asarray(x))
+    # capacity = max(4, ceil(16*1*0.01/2)) = 4 → 12 of 16 tokens dropped
+    norms = np.linalg.norm(np.asarray(out), axis=-1)
+    assert (norms > 1e-6).sum() == 4
+    assert (norms <= 1e-6).sum() == 12
+
+
+def test_aux_losses_positive_and_differentiable():
+    pt.seed(3)
+    layer = MoELayer(8, 16, num_experts=4, capacity_factor=2.0)
+    x = jnp.asarray(_tokens(16, 8, seed=5))
+    params = layer.trainable_state()
+
+    from paddle_tpu.nn.layer import bind_params
+
+    def loss(p):
+        with bind_params(layer, p):
+            out, aux = layer(x)
+        return jnp.sum(out ** 2) + aux
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    for k, g in grads.items():
+        assert np.all(np.isfinite(np.asarray(g))), k
+    # gate weight must receive gradient through the aux loss
+    assert float(jnp.abs(grads["gate.weight"]).sum()) > 0
+
+
+def test_moe_sharded_matches_serial():
+    pt.seed(4)
+    layer = MoELayer(16, 32, num_experts=4, capacity_factor=4.0)
+    x = jnp.asarray(_tokens(16, 16, seed=7).reshape(8, 2, 16))
+    ref, ref_aux = layer(x)
+
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, sharding_degree=2,
+                                      mp_degree=2)
+    dist.set_hybrid_group(hcg)
+    try:
+        dist.fleet.distributed_model(layer)
+
+        @jax.jit
+        def f(x):
+            return layer(x)
+
+        got, aux = f(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+    finally:
+        dist.set_hybrid_group(None)
