@@ -121,8 +121,11 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             entries.append({"offset": [0] * data.ndim,
                             "shape": list(data.shape), "file": fname})
             to_write.append((fname, data))
+        # dtype from the array itself, NOT the last written payload: a
+        # process may own no replica-0 shard of this key (replicated params
+        # on non-zero hosts), leaving `entries` empty.
         plan[key] = {"shape": list(np.shape(arr)),
-                     "dtype": str(np.asarray(to_write[-1][1]).dtype),
+                     "dtype": str(np.dtype(arr.dtype)),
                      "shards": entries}
 
     def write():

@@ -119,7 +119,14 @@ def _reduce_op(x, op: str, axes):
     if op in (ReduceOp.MIN, "min"):
         return lax.pmin(x, axes)
     if op in (ReduceOp.PROD, "prod"):
-        return jnp.exp(lax.psum(jnp.log(x), axes))
+        # sign/magnitude decomposition: exp(psum(log|x|)) handles magnitude,
+        # a parity psum of sign bits restores the sign, and an explicit zero
+        # mask avoids 0·inf → NaN (plain exp(psum(log x)) NaNs on negatives)
+        mag = jnp.exp(lax.psum(jnp.log(jnp.abs(x)), axes))
+        n_neg = lax.psum((x < 0).astype(jnp.int32), axes)
+        sign = jnp.where(n_neg % 2 == 0, 1, -1).astype(x.dtype)
+        any_zero = lax.pmax((x == 0).astype(jnp.int32), axes)
+        return jnp.where(any_zero > 0, jnp.zeros_like(mag), sign * mag)
     raise ValueError(f"unknown reduce op {op!r}")
 
 
@@ -259,7 +266,8 @@ def recv_prev(x, group=None, wrap: bool = True):
     n = _mesh_of(g).shape[g.axes[0]] if not _in_trace(x) else lax.axis_size(g.axes[0])
     perm = [((i + 1) % n, i) for i in range(n)]
     if not wrap:
-        perm = perm[1:]
+        # the wraparound edge (src 0 → dst n-1) is the last element
+        perm = perm[:-1]
     return ppermute(x, perm, g)
 
 

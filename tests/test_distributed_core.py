@@ -160,6 +160,44 @@ def test_send_next_recv_prev(hcg8):
     np.testing.assert_allclose(np.asarray(out).ravel(), [1, 2, 3, 0])
 
 
+def test_send_next_recv_prev_no_wrap(hcg8):
+    # wrap=False must drop exactly the wraparound edge: ranks that receive
+    # nothing get zeros (ppermute semantics)
+    x = jnp.arange(4.0).reshape(4, 1)
+
+    def fwd(v):
+        return dist.send_next(v, group="mp", wrap=False)
+
+    out = jax.shard_map(fwd, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P("mp"))(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), [0, 0, 1, 2])
+
+    def bwd(v):
+        return dist.recv_prev(v, group="mp", wrap=False)
+
+    out = jax.shard_map(bwd, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P("mp"))(x)
+    # rank i receives rank i+1's shard; last rank receives nothing
+    np.testing.assert_allclose(np.asarray(out).ravel(), [1, 2, 3, 0])
+
+
+def test_all_reduce_prod(hcg8):
+    # negatives and zeros must follow true product semantics
+    x = jnp.asarray([-2.0, 3.0, -4.0, 5.0])
+
+    def f(v):
+        return dist.all_reduce(v, op=dist.ReduceOp.PROD, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P())(x)
+    np.testing.assert_allclose(float(np.asarray(out)[0]), 120.0, rtol=1e-5)
+
+    xz = jnp.asarray([-2.0, 0.0, -4.0, 5.0])
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P())(xz)
+    np.testing.assert_allclose(float(np.asarray(out)[0]), 0.0)
+
+
 def test_axis_index_multi_axis(hcg8):
     def f(v):
         idx = dist.axis_index(dist.AxisGroup(("dp", "mp")))
