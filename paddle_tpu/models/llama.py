@@ -57,6 +57,10 @@ class LlamaConfig:
     initializer_range: float = 0.02
     dtype: str = "float32"
     recompute: bool = False
+    # remat policy when recompute=True: "full" (save only block boundaries),
+    # "dots" (save matmul outputs, recompute elementwise — the reference's
+    # selective recompute; cheaper re-FLOPs, more memory)
+    recompute_policy: str = "full"
     # context parallelism over the sep axis: "ring" | "ulysses" | "gspmd"
     # ("gspmd" = no explicit CP; XLA gathers KV per the sharding constraints)
     context_parallel: str = "ring"
@@ -66,6 +70,16 @@ class LlamaConfig:
             raise ValueError(
                 f"context_parallel must be 'ring', 'ulysses' or 'gspmd', "
                 f"got {self.context_parallel!r}")
+        if self.recompute_policy not in ("full", "dots"):
+            raise ValueError(
+                f"recompute_policy must be 'full' or 'dots', "
+                f"got {self.recompute_policy!r}")
+
+    @property
+    def remat_policy(self):
+        if self.recompute_policy == "dots":
+            return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        return None  # full remat
 
     @property
     def head_dim(self) -> int:
@@ -225,7 +239,8 @@ class LlamaModel(Layer):
         for block in self.layers:
             if c.recompute and self.training:
                 x = jax.checkpoint(
-                    lambda h, blk=block: blk(h, rope, position_ids))(x)
+                    lambda h, blk=block: blk(h, rope, position_ids),
+                    policy=c.remat_policy)(x)
             else:
                 x = block(x, rope, position_ids)
         return self.norm(x)
@@ -303,13 +318,15 @@ class LlamaDecoderLayerPipe(LlamaDecoderLayer):
         self.register_buffer("rope_cos", cos)
         self.register_buffer("rope_sin", sin)
         self._recompute = config.recompute
+        self.config = config
 
     def forward(self, x):
         rope = (self.rope_cos, self.rope_sin)
         if self._recompute and self.training:
             return jax.checkpoint(
                 lambda h: super(LlamaDecoderLayerPipe, self).forward(
-                    h, rope))(x)
+                    h, rope),
+                policy=self.config.remat_policy)(x)
         return super().forward(x, rope)
 
 
