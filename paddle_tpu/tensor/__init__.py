@@ -1,0 +1,33 @@
+"""paddle_tpu.tensor — the tensor-ops parity surface.
+
+TPU-native equivalent of the reference's ``python/paddle/tensor/`` package
+(creation / manipulation / math / logic / search / linalg / random ops) and
+of the C++ ``eager_method.cc`` Tensor-method table (see
+:mod:`.tensor_facade`).
+
+Everything here is a thin, convention-matching adapter from paddle's call
+signatures (``x``/``y``, ``axis``, ``keepdim``, explicit ``perm``) onto
+jnp/lax — the compute goes straight to XLA, which owns fusion and layout.
+All public names are re-exported at the package top level
+(``paddle_tpu.concat`` works like ``paddle.concat``).
+"""
+
+from .creation import *  # noqa: F401,F403
+from .creation import __all__ as _creation_all
+from .linalg import *  # noqa: F401,F403
+from .linalg import __all__ as _linalg_all
+from .logic import *  # noqa: F401,F403
+from .logic import __all__ as _logic_all
+from .manipulation import *  # noqa: F401,F403
+from .manipulation import __all__ as _manipulation_all
+from .math import *  # noqa: F401,F403
+from .math import __all__ as _math_all
+from .random import *  # noqa: F401,F403
+from .random import __all__ as _random_all
+from .search import *  # noqa: F401,F403
+from .search import __all__ as _search_all
+from .tensor_facade import Tensor  # noqa: F401
+
+__all__ = (list(_creation_all) + list(_manipulation_all) + list(_math_all)
+           + list(_logic_all) + list(_search_all) + list(_linalg_all)
+           + list(_random_all) + ["Tensor"])

@@ -1,0 +1,118 @@
+"""Tensor creation ops (parity surface: upstream python/paddle/tensor/creation.py).
+
+Thin, convention-matching wrappers over jnp: paddle argument names
+(``x``/``y``, ``axis``, ``keepdim``), paddle dtype defaults.  The heavy
+lifting — layout, fusion, device placement — is XLA's job.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+
+__all__ = [
+    "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
+    "empty", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "clone", "assign",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return to_jax_dtype(dtype)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype, jnp.float32))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _dt(dtype, jnp.float32))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, _dt(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, _dt(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, _dt(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, _dt(dtype))
+
+
+def empty(shape, dtype=None):
+    # XLA has no uninitialised buffers; zeros compiles to a broadcast
+    return jnp.zeros(shape, _dt(dtype, jnp.float32))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, _dt(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, _dt(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_dt(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, num, base=base, dtype=_dt(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype, jnp.float32))
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, offset=0, padding_value=0):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x.dtype)
+        idx = jnp.arange(x.shape[0])
+        r = idx if offset >= 0 else idx - offset
+        c = idx + offset if offset >= 0 else idx
+        return base.at[r, c].set(x)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def clone(x):
+    return jnp.array(x, copy=True)
+
+
+def assign(x, output=None):
+    out = jnp.asarray(x)
+    if output is not None:
+        raise ValueError("assign(output=) in-place form is not supported on "
+                         "immutable jax arrays; use the return value")
+    return out

@@ -1,0 +1,136 @@
+"""Linear-algebra ops (parity surface: upstream python/paddle/tensor/linalg.py).
+
+Wrappers over jnp.linalg; decompositions run on the host CPU path where XLA
+lacks a TPU lowering (XLA handles this transparently).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "norm", "t", "transpose", "dist", "cond", "det", "slogdet", "inv",
+    "pinv", "matrix_power", "matrix_rank", "cholesky", "qr", "svd", "eig",
+    "eigh", "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq",
+    "multi_dot", "matrix_transpose", "householder_product",
+]
+
+
+def norm(x, p=None, axis=None, keepdim: bool = False):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if isinstance(axis, (list, tuple)):
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    if p == jnp.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -jnp.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis,
+                   keepdims=keepdim) ** (1.0 / p)
+
+
+def t(x):
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim <= 2")
+    return x.T
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, axes=perm)
+
+
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+def dist(x, y, p=2):
+    return norm(jnp.ravel(x - y), p=p)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return sign, logabs
+
+
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian: bool = False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def matrix_power(x, n: int):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian: bool = False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def cholesky(x, upper: bool = False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def qr(x, mode: str = "reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices: bool = False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO: str = "L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO: str = "L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper: bool = True, transpose: bool = False,
+                     unitriangular: bool = False):
+    import jax
+    a = jnp.swapaxes(x, -1, -2) if transpose else x
+    return jax.scipy.linalg.solve_triangular(
+        a, y, lower=not upper if not transpose else upper,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def multi_dot(arrays):
+    return jnp.linalg.multi_dot(arrays)
+
+
+def householder_product(x, tau):
+    import jax
+    return jax.lax.linalg.householder_product(x, tau)

@@ -1,0 +1,107 @@
+"""Comparison / logical ops (parity surface: upstream
+python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
+    "bitwise_not", "bitwise_xor", "isnan", "isinf", "isfinite", "is_empty",
+    "where",
+]
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def is_empty(x):
+    return jnp.asarray(x).size == 0
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        # data-dependent shape → eager only
+        import numpy as np
+        return tuple(jnp.asarray(i)
+                     for i in np.where(np.asarray(condition)))
+    return jnp.where(condition, x, y)

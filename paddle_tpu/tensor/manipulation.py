@@ -1,0 +1,240 @@
+"""Tensor manipulation ops (parity surface: upstream
+python/paddle/tensor/manipulation.py).
+
+Paddle calling conventions over jnp/lax.  Ops whose output shape depends on
+data (``masked_select``, ``nonzero``-driven paths) are eager-only unless a
+static ``size`` style escape hatch exists — data-dependent shapes cannot
+live under ``jax.jit`` (XLA static-shape semantics); each such op documents
+it.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "concat", "stack", "split", "chunk", "squeeze", "unsqueeze", "reshape",
+    "flatten", "transpose", "moveaxis", "roll", "flip", "rot90", "tile",
+    "expand", "expand_as", "broadcast_to", "gather", "gather_nd", "scatter",
+    "scatter_nd_add", "index_select", "masked_select", "take_along_axis",
+    "put_along_axis", "repeat_interleave", "unbind", "unstack", "unique",
+    "cast", "slice", "strided_slice", "as_strided", "view",
+]
+
+
+def concat(x: Sequence, axis: int = 0):
+    return jnp.concatenate(list(x), axis=axis)
+
+
+def stack(x: Sequence, axis: int = 0):
+    return jnp.stack(list(x), axis=axis)
+
+
+def split(x, num_or_sections, axis: int = 0):
+    """paddle.split: int = equal parts; list = sizes (-1 = remainder)."""
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sizes = list(num_or_sections)
+    if -1 in sizes:
+        known = sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = x.shape[axis] - known
+    offsets = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += s
+        offsets.append(acc)
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks: int, axis: int = 0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, shape_or_dtype)
+    return x.view(shape_or_dtype)
+
+
+def flatten(x, start_axis: int = 0, stop_axis: int = -1):
+    nd = x.ndim
+    start = start_axis % nd
+    stop = stop_axis % nd
+    flat = 1
+    for d in x.shape[start:stop + 1]:
+        flat *= d
+    return jnp.reshape(x, x.shape[:start] + (flat,) + x.shape[stop + 1:])
+
+
+def transpose(x, perm):
+    """paddle.transpose takes an explicit permutation."""
+    return jnp.transpose(x, axes=perm)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def rot90(x, k: int = 1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def expand(x, shape):
+    """paddle.expand: -1 keeps the existing dim."""
+    tgt = list(shape)
+    src = (1,) * (len(tgt) - x.ndim) + x.shape
+    for i, s in enumerate(tgt):
+        if s == -1:
+            tgt[i] = src[i]
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def gather(x, index, axis: int = 0):
+    """paddle.gather: select rows of ``axis`` by a 1-D index."""
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    """Index with the last dim of ``index`` addressing leading dims of x."""
+    index = jnp.asarray(index)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite: bool = True):
+    """paddle.scatter along dim 0 (functional: returns a new array)."""
+    x = jnp.asarray(x)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def index_select(x, index, axis: int = 0):
+    return jnp.take(x, index, axis=axis)
+
+
+def masked_select(x, mask):
+    """Data-dependent output shape → eager only (not jittable)."""
+    import numpy as np
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def take_along_axis(arr, indices, axis, broadcast: bool = True):
+    if broadcast:
+        shape = list(arr.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+def put_along_axis(arr, indices, values, axis, reduce: str = "assign"):
+    arr = jnp.asarray(arr)
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, values, axis=axis,
+                                  inplace=False)
+    dims = list(range(arr.ndim))
+    del dims[axis]
+    idx = jnp.indices(indices.shape)
+    full = [idx[d] for d in range(arr.ndim)]
+    full[axis] = indices
+    if reduce == "add":
+        return arr.at[tuple(full)].add(values)
+    if reduce == "multiply":
+        return arr.at[tuple(full)].multiply(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unbind(x, axis: int = 0):
+    return [jnp.squeeze(s, axis)
+            for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+unstack = unbind
+
+
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    """Data-dependent output shape → eager only (not jittable)."""
+    import numpy as np
+    out = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(out, tuple):
+        return tuple(jnp.asarray(o) for o in out)
+    return jnp.asarray(out)
+
+
+def cast(x, dtype):
+    from ..framework.dtype import to_jax_dtype
+    return x.astype(to_jax_dtype(dtype))
+
+
+def slice(x, axes, starts, ends):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = jnp.s_[st:en]
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[st:en:sd]
+    return x[tuple(idx)]
+
+
+def as_strided(x, shape, stride, offset: int = 0):
+    """Reference semantics over flat memory; implemented by explicit gather
+    (XLA has no aliasing views)."""
+    flat = jnp.ravel(x)
+    idx = jnp.full(tuple(shape), offset)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s) * st
+        idx = idx + jnp.expand_dims(
+            r, tuple(i for i in range(len(shape)) if i != d))
+    return flat[idx]

@@ -1,0 +1,415 @@
+"""Tensor math ops (parity surface: upstream python/paddle/tensor/math.py).
+
+Paddle calling conventions (``x``/``y``, ``axis``, ``keepdim``) over jnp.
+XLA fuses these elementwise chains into surrounding matmuls — no hand-fused
+kernels needed at this layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    # binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "heaviside", "lerp", "outer", "inner", "cross", "dot", "matmul", "mm",
+    "bmm", "mv", "add_n",
+    # unary
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "reciprocal", "abs", "neg", "sign", "floor", "ceil", "round",
+    "trunc", "frac", "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+    "cosh", "asinh", "acosh", "atanh", "erf", "erfinv", "sigmoid", "tanh",
+    "deg2rad", "rad2deg", "angle", "conj", "real", "imag", "digamma",
+    "lgamma", "logit", "nan_to_num",
+    # clip / reductions
+    "clip", "sum", "nansum", "mean", "nanmean", "prod", "max", "min",
+    "amax", "amin", "cumsum", "cumprod", "cummax", "cummin", "logsumexp",
+    "logcumsumexp", "count_nonzero", "all", "any", "diff", "trace",
+]
+
+
+# -- binary ------------------------------------------------------------------
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def dot(x, y):
+    """paddle.dot: 1-D (or batched row-wise) inner product."""
+    return jnp.sum(x * y, axis=-1)
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def add_n(inputs):
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+# -- unary -------------------------------------------------------------------
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# -- clip / reductions -------------------------------------------------------
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def sum(x, axis=None, dtype=None, keepdim: bool = False):
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim: bool = False):
+    return jnp.nansum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim: bool = False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim: bool = False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim: bool = False, dtype=None):
+    return jnp.prod(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim: bool = False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim: bool = False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = jnp.ravel(x)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    values = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    # index of the running max = first position attaining the running value
+    eq = jnp.equal(jnp.moveaxis(values, axis, -1)[..., :, None],
+                   jnp.moveaxis(x, axis, -1)[..., None, :])
+    n = x.shape[axis]
+    causal = jnp.tril(jnp.ones((n, n), bool))
+    idx = jnp.argmax(eq & causal, axis=-1)
+    indices = jnp.moveaxis(idx, -1, axis)
+    return values, indices
+
+
+def cummin(x, axis=None):
+    values, indices = cummax(-x, axis=axis)
+    return -values, indices
+
+
+def logsumexp(x, axis=None, keepdim: bool = False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    # logaddexp is associative → a single XLA scan, numerically stable
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def count_nonzero(x, axis=None, keepdim: bool = False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim: bool = False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim: bool = False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def diff(x, n: int = 1, axis: int = -1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)

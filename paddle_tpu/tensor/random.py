@@ -1,0 +1,96 @@
+"""Random tensor ops (parity surface: upstream python/paddle/tensor/random.py).
+
+Stateful-looking API (``paddle.rand`` etc.) over jax's functional PRNG: each
+call draws the next key from the framework's global key chain
+(``paddle_tpu.seed`` / ``framework.random.next_key``), so results are
+reproducible from ``seed()`` like the reference's global generator.
+Inside ``jit``, pass an explicit ``key=`` instead (the global chain is a
+host-side effect).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from ..framework.random import next_key
+
+__all__ = [
+    "rand", "randn", "randint", "randperm", "uniform", "normal",
+    "standard_normal", "bernoulli", "multinomial", "poisson", "exponential",
+    "shuffle",
+]
+
+
+def _key(key):
+    return key if key is not None else next_key()
+
+
+def _dt(dtype, default=jnp.float32):
+    return to_jax_dtype(dtype) if dtype is not None else default
+
+
+def rand(shape, dtype=None, key=None):
+    return jax.random.uniform(_key(key), tuple(shape), _dt(dtype))
+
+
+def randn(shape, dtype=None, key=None):
+    return jax.random.normal(_key(key), tuple(shape), _dt(dtype))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), tuple(shape), low, high,
+                              _dt(dtype, jnp.int32))
+
+
+def randperm(n: int, dtype="int64", key=None):
+    return jax.random.permutation(_key(key), n).astype(_dt(dtype, jnp.int32))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None):
+    return jax.random.uniform(_key(key), tuple(shape), _dt(dtype),
+                              minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,), key=None):
+    return mean + std * jax.random.normal(_key(key), tuple(shape))
+
+
+def bernoulli(x, key=None):
+    return (jax.random.uniform(_key(key), x.shape) < x).astype(x.dtype)
+
+
+def multinomial(x, num_samples: int = 1, replacement: bool = False,
+                key=None):
+    """Sample category indices ∝ x along the last axis (Gumbel trick:
+    argmax with replacement, top-k without)."""
+    x = jnp.asarray(x)
+    logits = jnp.log(x)
+    k = _key(key)
+    if replacement:
+        g = jax.random.gumbel(k, (num_samples,) + x.shape)
+        idx = jnp.argmax(logits + g, axis=-1)       # (num_samples, *batch)
+        return jnp.moveaxis(idx, 0, -1)             # (*batch, num_samples)
+    g = jax.random.gumbel(k, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def poisson(x, key=None):
+    return jax.random.poisson(_key(key), jnp.asarray(x)).astype(jnp.float32)
+
+
+def exponential(x, key=None):
+    return jax.random.exponential(_key(key), jnp.shape(x)).astype(
+        jnp.asarray(x).dtype)
+
+
+def shuffle(x, axis: int = 0, key=None):
+    return jax.random.permutation(_key(key), x, axis=axis,
+                                  independent=False)

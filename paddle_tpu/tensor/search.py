@@ -1,0 +1,121 @@
+"""Search / sort ops (parity surface: upstream python/paddle/tensor/search.py).
+
+``topk``/``sort`` lower to XLA's sort/top-k HLOs — no custom kernels.  Ops
+with data-dependent output shapes (``nonzero``) are eager-only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "searchsorted",
+    "index_sample", "kthvalue", "mode", "median", "quantile", "histogram",
+    "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim: bool = False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype) if dtype != "int64" else out.dtype)
+
+
+def argmin(x, axis=None, keepdim: bool = False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype) if dtype != "int64" else out.dtype)
+
+
+def argsort(x, axis: int = -1, descending: bool = False, stable: bool = True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def sort(x, axis: int = -1, descending: bool = False, stable: bool = True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def topk(x, k: int, axis: int = -1, largest: bool = True,
+         sorted: bool = True):
+    """XLA top-k on the requested axis; ``largest=False`` via negation
+    (the reference dispatches a dedicated bottom-k kernel)."""
+    del sorted  # XLA top_k is always sorted
+    x_moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(x_moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-x_moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def nonzero(x, as_tuple: bool = False):
+    """Data-dependent output shape → eager only (not jittable)."""
+    import numpy as np
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in idx)
+    return jnp.asarray(np.stack(idx, axis=1))
+
+
+def searchsorted(sorted_sequence, values, out_int32: bool = False,
+                 right: bool = False):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def index_sample(x, index):
+    """Per-row gather: out[i, j] = x[i, index[i, j]]."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def kthvalue(x, k: int, axis: int = -1, keepdim: bool = False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis, stable=True)
+    sel = jnp.take(vals, k - 1, axis=axis)
+    sel_i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        sel = jnp.expand_dims(sel, axis)
+        sel_i = jnp.expand_dims(sel_i, axis)
+    return sel, sel_i
+
+
+def mode(x, axis: int = -1, keepdim: bool = False):
+    """Most frequent value (ties → smallest value), index of its last
+    occurrence.  O(n²) pairwise count — fine for the op-parity surface;
+    heavy histogramming belongs in user code."""
+    xm = jnp.moveaxis(x, axis, -1)
+    n = xm.shape[-1]
+    cnt = jnp.sum(xm[..., :, None] == xm[..., None, :], axis=-1)
+    maxc = jnp.max(cnt, axis=-1, keepdims=True)
+    # min over max-count candidates; fill others with the row max (any mode
+    # candidate is <= it, so fills never win the min)
+    fill = jnp.max(xm, axis=-1, keepdims=True)
+    val = jnp.min(jnp.where(cnt == maxc, xm, fill), axis=-1)
+    eq = xm == val[..., None]
+    idx = (n - 1) - jnp.argmax(jnp.flip(eq, axis=-1), axis=-1)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return val, idx
+
+
+def median(x, axis=None, keepdim: bool = False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim: bool = False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def histogram(x, bins: int = 100, min: float = 0.0, max: float = 0.0):
+    rng = None if (min == 0.0 and max == 0.0) else (min, max)
+    hist, _ = jnp.histogram(jnp.ravel(x), bins=bins, range=rng)
+    return hist
+
+
+def bucketize(x, sorted_sequence, out_int32: bool = False,
+              right: bool = False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

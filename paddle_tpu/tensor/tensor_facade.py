@@ -1,0 +1,171 @@
+"""Tensor facade — paddle.Tensor method surface over jax.Array.
+
+Design stance (vs the reference's ~200k-LoC ``python/paddle/tensor/`` +
+C++ ``eager_method.cc`` method table): on TPU the array type IS
+``jax.Array`` — it already carries the numpy-style method surface
+(``.sum``, ``.reshape``, ``.astype``, arithmetic operators) and flows
+through jit/grad/sharding natively, so the framework does NOT wrap arrays
+by default.  This module adds the *paddle-specific* method names as an
+opt-in facade:
+
+  * ``Tensor(x)`` wraps any array-like; it is a registered pytree node, so
+    wrapped values pass through ``jax.jit``/``jax.grad`` unchanged;
+  * every public function in ``paddle_tpu.tensor`` is exposed as a method
+    (``t.matmul(y)``, ``t.cast('float32')``, ``t.unsqueeze(0)``, ...) via
+    dispatch-by-name — one source of truth, no 400-method class body;
+  * arithmetic/comparison dunders, ``.numpy()``, ``.item()``, ``.clone()``,
+    ``.T``, indexing, and ``__jax_array__`` (so wrapped tensors feed any
+    jnp function directly).
+
+Methods return plain jax.Arrays (unwrap-on-return): the facade is an entry
+convenience, not a parallel type system.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Tensor"]
+
+_OPS = None
+
+
+def _ops():
+    global _OPS
+    if _OPS is None:
+        from .. import tensor as _t
+        _OPS = _t
+    return _OPS
+
+
+def _unwrap(v):
+    return v.value if isinstance(v, Tensor) else v
+
+
+class Tensor:
+    """Opt-in paddle.Tensor-method facade over a jax.Array."""
+
+    __slots__ = ("value",)
+    __array_priority__ = 100  # win binary ops vs numpy arrays
+
+    def __init__(self, value):
+        if isinstance(value, Tensor):
+            value = value.value
+        object.__setattr__(self, "value", jnp.asarray(value))
+
+    # -- interop ------------------------------------------------------------
+    def __jax_array__(self):
+        return self.value
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.value)
+
+    def item(self):
+        return self.value.item()
+
+    def clone(self):
+        return Tensor(jnp.array(self.value, copy=True))
+
+    def detach(self):
+        return Tensor(jax.lax.stop_gradient(self.value))
+
+    # -- shape/dtype --------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    @property
+    def size(self):
+        return self.value.size
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def T(self):
+        return Tensor(self.value.T)
+
+    def __len__(self):
+        return len(self.value)
+
+    # -- dispatch-by-name to paddle_tpu.tensor ------------------------------
+    def __getattr__(self, name):
+        ops = _ops()
+        fn = getattr(ops, name, None)
+        if fn is None or not callable(fn):
+            # fall back to the jax.Array method surface (.mean, .astype, ...)
+            attr = getattr(self.value, name)
+            if callable(attr):
+                return lambda *a, **k: attr(*[_unwrap(x) for x in a], **k)
+            return attr
+
+        def method(*args, **kwargs):
+            args = [_unwrap(a) for a in args]
+            kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+            return fn(self.value, *args, **kwargs)
+        return method
+
+    # -- operators ----------------------------------------------------------
+    def __getitem__(self, idx):
+        return Tensor(self.value[_unwrap(idx)])
+
+    def __repr__(self):
+        return f"Tensor({self.value!r})"
+
+    def __format__(self, spec):
+        return format(self.value, spec)
+
+    def __bool__(self):
+        return bool(self.value)
+
+    def __int__(self):
+        return int(self.value)
+
+    def __float__(self):
+        return float(self.value)
+
+    def __iter__(self):
+        return (Tensor(v) for v in self.value)
+
+
+def _binop(name, jnp_fn, reflected=False):
+    if reflected:
+        def op(self, other):
+            return Tensor(jnp_fn(_unwrap(other), self.value))
+    else:
+        def op(self, other):
+            return Tensor(jnp_fn(self.value, _unwrap(other)))
+    op.__name__ = name
+    setattr(Tensor, name, op)
+
+
+for _name, _fn in [("__add__", jnp.add), ("__sub__", jnp.subtract),
+                   ("__mul__", jnp.multiply), ("__truediv__", jnp.divide),
+                   ("__floordiv__", jnp.floor_divide), ("__mod__", jnp.mod),
+                   ("__pow__", jnp.power), ("__matmul__", jnp.matmul),
+                   ("__eq__", jnp.equal), ("__ne__", jnp.not_equal),
+                   ("__lt__", jnp.less), ("__le__", jnp.less_equal),
+                   ("__gt__", jnp.greater), ("__ge__", jnp.greater_equal),
+                   ("__and__", jnp.bitwise_and), ("__or__", jnp.bitwise_or),
+                   ("__xor__", jnp.bitwise_xor)]:
+    _binop(_name, _fn)
+for _name, _fn in [("__radd__", jnp.add), ("__rsub__", jnp.subtract),
+                   ("__rmul__", jnp.multiply), ("__rtruediv__", jnp.divide),
+                   ("__rmatmul__", jnp.matmul), ("__rpow__", jnp.power)]:
+    _binop(_name, _fn, reflected=True)
+Tensor.__neg__ = lambda self: Tensor(jnp.negative(self.value))
+Tensor.__abs__ = lambda self: Tensor(jnp.abs(self.value))
+Tensor.__invert__ = lambda self: Tensor(jnp.bitwise_not(self.value))
+Tensor.__hash__ = lambda self: id(self)
+
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t.value,), None),
+    lambda _, children: Tensor(children[0]))

@@ -1,0 +1,399 @@
+"""Tensor-ops surface: NumPy-oracle + finite-difference grad checks via the
+OpTest harness (the reference's test/legacy_test/test_*_op.py pattern,
+SURVEY.md §4), plus the Tensor facade."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(42)
+
+
+def A(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+# -- math: forward oracle + FD grad, dtype-parameterised ---------------------
+
+UNARY = [
+    (pt.exp, np.exp, 0.5 * A(3, 4), {}),
+    (pt.log, np.log, np.abs(A(3, 4)) + 0.5, {}),
+    (pt.sqrt, np.sqrt, np.abs(A(3, 4)) + 0.5, {}),
+    (pt.rsqrt, lambda x: 1.0 / np.sqrt(x), np.abs(A(3, 4)) + 0.5, {}),
+    (pt.square, np.square, A(3, 4), {}),
+    (pt.abs, np.abs, A(3, 4) + 0.1, {}),
+    (pt.sin, np.sin, A(3, 4), {}),
+    (pt.cos, np.cos, A(3, 4), {}),
+    (pt.tanh, np.tanh, A(3, 4), {}),
+    (pt.sigmoid, lambda x: 1 / (1 + np.exp(-x)), A(3, 4), {}),
+    (pt.erf, None, A(3, 4), {}),  # oracle via scipy-free identity below
+    (pt.floor, np.floor, A(3, 4) * 3, {}),
+    (pt.ceil, np.ceil, A(3, 4) * 3, {}),
+    (pt.round, np.round, A(3, 4) * 3, {}),
+    (pt.sign, np.sign, A(3, 4), {}),
+    (pt.log1p, np.log1p, np.abs(A(3, 4)), {}),
+    (pt.expm1, np.expm1, 0.3 * A(3, 4), {}),
+    (pt.reciprocal, lambda x: 1.0 / x, np.abs(A(3, 4)) + 1.0, {}),
+]
+
+
+@pytest.mark.parametrize(
+    "op,oracle,x,kw", UNARY,
+    ids=[u[0].__name__ for u in UNARY])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_unary_forward(op, oracle, x, kw, dtype):
+    if oracle is None:
+        import math
+        oracle = np.vectorize(math.erf)
+    check_output(op, oracle, [x], kw, dtype=dtype)
+
+
+@pytest.mark.parametrize(
+    "op,x", [(pt.exp, 0.5 * A(2, 3)), (pt.log, np.abs(A(2, 3)) + 0.5),
+             (pt.sqrt, np.abs(A(2, 3)) + 0.5), (pt.tanh, A(2, 3)),
+             (pt.sigmoid, A(2, 3)), (pt.square, A(2, 3)),
+             (pt.rsqrt, np.abs(A(2, 3)) + 0.5)],
+    ids=["exp", "log", "sqrt", "tanh", "sigmoid", "square", "rsqrt"])
+def test_unary_grad(op, x):
+    check_grad(op, [x])
+
+
+BINARY = [
+    (pt.add, np.add), (pt.subtract, np.subtract),
+    (pt.multiply, np.multiply), (pt.divide, np.divide),
+    (pt.maximum, np.maximum), (pt.minimum, np.minimum),
+    (pt.atan2, np.arctan2),
+]
+
+
+@pytest.mark.parametrize("op,oracle", BINARY,
+                         ids=[b[0].__name__ for b in BINARY])
+def test_binary_forward_and_grad(op, oracle):
+    x, y = A(3, 4), np.abs(A(3, 4)) + 0.5
+    check_output(op, oracle, [x, y])
+    check_grad(op, [x, y], grad_argnums=(0, 1))
+
+
+def test_matmul_variants():
+    x, y = A(3, 4), A(4, 5)
+    check_output(pt.matmul, np.matmul, [x, y])
+    check_output(lambda a, b: pt.matmul(a, b, transpose_y=True),
+                 lambda a, b: a @ b.T, [x, A(5, 4)])
+    check_grad(pt.matmul, [x, y], grad_argnums=(0, 1))
+    b1, b2 = A(2, 3, 4), A(2, 4, 5)
+    check_output(pt.bmm, np.matmul, [b1, b2])
+    check_output(pt.dot, lambda a, b: np.sum(a * b, -1), [A(5), A(5)])
+
+
+REDUCTIONS = [
+    (pt.sum, np.sum), (pt.mean, np.mean), (pt.prod, np.prod),
+    (pt.max, np.max), (pt.min, np.min),
+]
+
+
+@pytest.mark.parametrize("op,oracle", REDUCTIONS,
+                         ids=[r[0].__name__ for r in REDUCTIONS])
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (1, False),
+                                          (1, True), ((0, 1), False)])
+def test_reductions(op, oracle, axis, keepdim):
+    x = np.abs(A(3, 4, 2)) + 0.1
+    check_output(op, lambda v, axis=None, keepdim=False:
+                 oracle(v, axis=axis, keepdims=keepdim),
+                 [x], {"axis": axis, "keepdim": keepdim})
+
+
+def test_reduction_grads():
+    x = A(3, 4)
+    check_grad(pt.sum, [x])
+    check_grad(pt.mean, [x])
+    check_grad(lambda v: pt.max(v, axis=1), [x])
+    check_grad(lambda v: pt.logsumexp(v, axis=1), [x])
+
+
+def test_cumulative():
+    x = A(3, 5)
+    check_output(pt.cumsum, lambda v, axis=None: np.cumsum(v, axis),
+                 [x], {"axis": 1})
+    check_output(pt.cumprod, lambda v, dim=None: np.cumprod(v, dim),
+                 [0.5 + np.abs(A(3, 5))], {"dim": 1})
+    ref = np.logaddexp.accumulate(x.astype(np.float64), axis=1)
+    got = pt.logcumsumexp(x, axis=1)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+    vals, idx = pt.cummax(x, axis=1)
+    np.testing.assert_allclose(np.asarray(vals),
+                               np.maximum.accumulate(x, axis=1), rtol=1e-6)
+    # indices point at the position of the running max
+    np.testing.assert_array_equal(
+        np.take_along_axis(x, np.asarray(idx), 1), np.asarray(vals))
+    check_grad(lambda v: pt.cumsum(v, axis=1), [x])
+
+
+def test_clip_and_lerp():
+    x = A(3, 4) * 3
+    check_output(pt.clip, lambda v, min=None, max=None: np.clip(v, min, max),
+                 [x], {"min": -1.0, "max": 1.0})
+    check_output(pt.lerp, lambda a, b, weight: a + weight * (b - a),
+                 [A(3, 4), A(3, 4)], {"weight": 0.3})
+
+
+# -- creation ----------------------------------------------------------------
+
+def test_creation_ops():
+    np.testing.assert_array_equal(np.asarray(pt.zeros([2, 3])),
+                                  np.zeros((2, 3)))
+    np.testing.assert_array_equal(np.asarray(pt.ones([2], "int32")),
+                                  np.ones(2, np.int32))
+    np.testing.assert_array_equal(np.asarray(pt.full([2, 2], 7.0)),
+                                  np.full((2, 2), 7.0))
+    np.testing.assert_array_equal(np.asarray(pt.arange(3, 11, 2)),
+                                  np.arange(3, 11, 2))
+    np.testing.assert_allclose(np.asarray(pt.linspace(0, 1, 5)),
+                               np.linspace(0, 1, 5))
+    np.testing.assert_array_equal(np.asarray(pt.eye(3)), np.eye(3))
+    x = A(4, 4)
+    np.testing.assert_array_equal(np.asarray(pt.tril(x)), np.tril(x))
+    np.testing.assert_array_equal(np.asarray(pt.triu(x, 1)), np.triu(x, 1))
+    np.testing.assert_array_equal(np.asarray(pt.diag(np.arange(3.0))),
+                                  np.diag(np.arange(3.0)))
+    np.testing.assert_array_equal(np.asarray(pt.zeros_like(x)),
+                                  np.zeros_like(x))
+
+
+# -- manipulation ------------------------------------------------------------
+
+def test_concat_stack_split():
+    xs = [A(2, 3), A(2, 3)]
+    check_output(pt.concat, lambda v, axis=0: np.concatenate(v, axis),
+                 [xs], {"axis": 1})
+    check_output(pt.stack, lambda v, axis=0: np.stack(v, axis), [xs],
+                 {"axis": 0})
+    x = A(6, 4)
+    parts = pt.split(x, 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == (2, 4)
+    parts = pt.split(x, [1, 2, -1], axis=0)
+    assert [p.shape[0] for p in parts] == [1, 2, 3]
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(p) for p in parts]), x)
+    check_grad(lambda a, b: pt.concat([a, b], axis=0), [xs[0], xs[1]],
+               grad_argnums=(0, 1))
+
+
+def test_reshape_transpose_squeeze():
+    x = A(2, 3, 4)
+    check_output(pt.reshape, lambda v, shape=None: np.reshape(v, shape),
+                 [x], {"shape": [4, 6]})
+    check_output(pt.transpose, lambda v, perm=None: np.transpose(v, perm),
+                 [x], {"perm": [2, 0, 1]})
+    check_output(pt.flatten, lambda v, start_axis=0, stop_axis=-1:
+                 v.reshape(2, 12), [x], {"start_axis": 1, "stop_axis": 2})
+    y = A(2, 1, 3)
+    assert pt.squeeze(y, axis=1).shape == (2, 3)
+    assert pt.unsqueeze(y, 0).shape == (1, 2, 1, 3)
+    check_grad(lambda v: pt.transpose(v, [1, 0, 2]), [x])
+
+
+def test_gather_scatter_family():
+    x = A(5, 4)
+    idx = np.array([0, 2, 4])
+    check_output(pt.gather, lambda v, i, axis=0: np.take(v, i, axis),
+                 [x, idx])
+    nd_idx = np.array([[0, 1], [2, 3]])
+    np.testing.assert_allclose(np.asarray(pt.gather_nd(x, nd_idx)),
+                               x[[0, 2], [1, 3]])
+    upd = A(3, 4)
+    out = pt.scatter(x, idx, upd)
+    ref = x.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(np.asarray(out), ref)
+    out = pt.scatter(x, idx, upd, overwrite=False)
+    ref = x.copy()
+    np.add.at(ref, idx, upd)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+    ti = np.argsort(A(5, 4), axis=1)
+    check_output(pt.take_along_axis,
+                 lambda v, i, axis: np.take_along_axis(v, i, axis),
+                 [x, ti], {"axis": 1})
+    check_grad(lambda v: pt.gather(v, idx), [x])
+
+
+def test_tile_expand_flip_roll():
+    x = A(2, 3)
+    check_output(pt.tile, lambda v, repeat_times: np.tile(v, repeat_times),
+                 [x], {"repeat_times": (2, 2)})
+    assert pt.expand(x, [4, 2, 3]).shape == (4, 2, 3)
+    assert pt.expand(A(1, 3), [5, -1]).shape == (5, 3)
+    check_output(pt.flip, lambda v, axis: np.flip(v, axis), [x], {"axis": 0})
+    check_output(pt.roll, lambda v, shifts, axis=None:
+                 np.roll(v, shifts, axis), [x], {"shifts": 2, "axis": 1})
+    np.testing.assert_array_equal(
+        np.asarray(pt.repeat_interleave(x, 2, axis=1)),
+        np.repeat(x, 2, axis=1))
+
+
+def test_masked_select_unique_nonzero_eager():
+    x = np.array([[1.0, -2.0], [3.0, -4.0]])
+    np.testing.assert_array_equal(np.asarray(pt.masked_select(x, x > 0)),
+                                  [1.0, 3.0])
+    u, counts = pt.unique(np.array([3, 1, 1, 2]), return_counts=True)
+    np.testing.assert_array_equal(np.asarray(u), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(counts), [2, 1, 1])
+    nz = pt.nonzero(np.array([0, 5, 0, 7]))
+    np.testing.assert_array_equal(np.asarray(nz), [[1], [3]])
+
+
+def test_cast_and_chunk():
+    x = A(4, 6)
+    assert pt.cast(x, "bfloat16").dtype == jnp.bfloat16
+    assert pt.cast(x, "int32").dtype == jnp.int32
+    cs = pt.chunk(x, 3, axis=1)
+    assert len(cs) == 3 and cs[0].shape == (4, 2)
+
+
+# -- logic -------------------------------------------------------------------
+
+def test_logic_ops():
+    x, y = A(3, 3), A(3, 3)
+    np.testing.assert_array_equal(np.asarray(pt.greater_than(x, y)), x > y)
+    np.testing.assert_array_equal(np.asarray(pt.less_equal(x, y)), x <= y)
+    np.testing.assert_array_equal(
+        np.asarray(pt.logical_and(x > 0, y > 0)), (x > 0) & (y > 0))
+    assert bool(pt.allclose(x, x + 1e-9))
+    assert not bool(pt.allclose(x, x + 1.0))
+    assert bool(pt.equal_all(x, x))
+    z = np.array([1.0, np.nan, np.inf])
+    np.testing.assert_array_equal(np.asarray(pt.isnan(z)), np.isnan(z))
+    np.testing.assert_array_equal(np.asarray(pt.isfinite(z)), np.isfinite(z))
+    check_output(pt.where, lambda c, a, b: np.where(c, a, b),
+                 [x > 0, x, y])
+
+
+# -- search / sort -----------------------------------------------------------
+
+def test_sort_family():
+    x = A(4, 6)
+    check_output(pt.sort, lambda v, axis=-1, **k: np.sort(v, axis), [x])
+    np.testing.assert_array_equal(np.asarray(pt.argsort(x, axis=1)),
+                                  np.argsort(x, axis=1, kind="stable"))
+    vals, idx = pt.topk(x, 3, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(np.asarray(vals), ref)
+    np.testing.assert_allclose(np.take_along_axis(x, np.asarray(idx), 1),
+                               ref)
+    vals, _ = pt.topk(x, 2, axis=1, largest=False)
+    np.testing.assert_allclose(np.asarray(vals), np.sort(x, axis=1)[:, :2])
+    check_output(pt.argmax, lambda v, axis=None, **k: np.argmax(v, axis),
+                 [x], {"axis": 1})
+    check_output(pt.median, lambda v, axis=None, **k: np.median(v, axis),
+                 [x], {"axis": 1})
+    v, i = pt.kthvalue(x, 2, axis=1)
+    np.testing.assert_allclose(np.asarray(v), np.sort(x, axis=1)[:, 1])
+
+
+def test_mode_and_searchsorted():
+    x = np.array([[1, 2, 2, 3], [5, 5, 4, 4]])
+    vals, idx = pt.mode(x, axis=1)
+    np.testing.assert_array_equal(np.asarray(vals), [2, 4])
+    np.testing.assert_array_equal(np.asarray(idx), [2, 3])
+    seq = np.array([1.0, 3.0, 5.0, 7.0])
+    check_output(pt.searchsorted,
+                 lambda s, v, **k: np.searchsorted(s, v),
+                 [seq, np.array([0.0, 4.0, 9.0])])
+
+
+# -- linalg ------------------------------------------------------------------
+
+def test_linalg_ops():
+    x = A(4, 4)
+    spd = x @ x.T + 4 * np.eye(4, dtype=np.float32)
+    check_output(pt.norm, lambda v, **k: np.linalg.norm(v), [x])
+    check_output(pt.det, np.linalg.det, [spd], rtol=1e-4)
+    sol = pt.solve(spd, A(4, 2))
+    assert sol.shape == (4, 2)
+    L = pt.cholesky(spd)
+    np.testing.assert_allclose(np.asarray(L @ L.T), spd, rtol=1e-4,
+                               atol=1e-4)
+    m = A(5, 3)
+    q, r = pt.qr(m)
+    np.testing.assert_allclose(np.asarray(q @ r), m, rtol=1e-4, atol=1e-4)
+    assert q.shape == (5, 3) and r.shape == (3, 3)
+    u, s, vt = pt.svd(x)
+    np.testing.assert_allclose(np.asarray((u * s) @ vt), x, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pt.t(x)), x.T)
+    check_grad(lambda v: pt.norm(v), [x])
+
+
+# -- random ------------------------------------------------------------------
+
+def test_random_ops_reproducible():
+    pt.seed(123)
+    a = pt.rand([3, 4])
+    pt.seed(123)
+    b = pt.rand([3, 4])
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert pt.randn([2, 2]).shape == (2, 2)
+    r = pt.randint(0, 10, [100])
+    assert int(np.asarray(r).min()) >= 0 and int(np.asarray(r).max()) < 10
+    p = pt.randperm(10)
+    np.testing.assert_array_equal(np.sort(np.asarray(p)), np.arange(10))
+    u = np.asarray(pt.uniform([500], min=2.0, max=3.0))
+    assert u.min() >= 2.0 and u.max() <= 3.0
+    m = pt.multinomial(np.array([0.0, 0.0, 1.0]), 5, replacement=True)
+    np.testing.assert_array_equal(np.asarray(m), [2] * 5)
+    m = pt.multinomial(np.array([0.1, 0.2, 0.7]), 3, replacement=False)
+    np.testing.assert_array_equal(np.sort(np.asarray(m)), [0, 1, 2])
+
+
+# -- Tensor facade -----------------------------------------------------------
+
+def test_tensor_facade_methods():
+    t = pt.Tensor(A(3, 4))
+    assert isinstance(t.matmul(A(4, 2)), jnp.ndarray)
+    assert t.cast("bfloat16").dtype == jnp.bfloat16
+    assert t.unsqueeze(0).shape == (1, 3, 4)
+    assert t.shape == [3, 4] and t.ndim == 2
+    np.testing.assert_allclose(t.numpy(), np.asarray(t.value))
+    s = t.sum(axis=1)  # jax.Array method fallback
+    assert np.asarray(s).shape == (3,)
+
+
+def test_tensor_facade_operators():
+    a, b = A(2, 3), A(2, 3)
+    ta, tb = pt.Tensor(a), pt.Tensor(b)
+    np.testing.assert_allclose(np.asarray((ta + tb).value), a + b)
+    np.testing.assert_allclose(np.asarray((ta * 2.0).value), a * 2)
+    np.testing.assert_allclose(np.asarray((1.0 - ta).value), 1 - a,
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray((ta @ pt.Tensor(A(3, 2))).value).shape, (2, 2))
+    np.testing.assert_array_equal(np.asarray((ta > tb).value), a > b)
+    np.testing.assert_allclose(np.asarray((-ta).value), -a)
+    np.testing.assert_allclose(np.asarray(ta[0].value), a[0])
+    assert float((ta - ta).sum()) == 0.0
+
+
+def test_tensor_facade_is_pytree():
+    import jax
+
+    t = pt.Tensor(A(2, 2))
+
+    @jax.jit
+    def f(v):
+        return v + 1.0
+
+    out = f(t)
+    assert isinstance(out, pt.Tensor)
+    np.testing.assert_allclose(np.asarray(out.value),
+                               np.asarray(t.value) + 1)
+    g = jax.grad(lambda v: (v * v).sum())(t)
+    assert isinstance(g, pt.Tensor)
+
+
+def test_tensor_facade_jnp_interop():
+    t = pt.Tensor(A(3, 3))
+    out = jnp.exp(t)  # __jax_array__ protocol
+    np.testing.assert_allclose(np.asarray(out),
+                               np.exp(np.asarray(t.value)), rtol=1e-6)
