@@ -23,7 +23,7 @@ from .parallelize import (build_eval_step, build_train_step,
                           shard_batch, zero_shard_spec)
 from .topology import (AXIS_ORDER, CommunicateTopology,
                        HybridCommunicateGroup, ParallelMode)
-from . import checkpoint, fleet
+from . import checkpoint, fleet, launch
 from .checkpoint import load_state_dict, save_state_dict
 from . import moe
 from .context_parallel import context_parallel_attention
@@ -32,7 +32,7 @@ from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,
                        SharedLayerDesc)
 
 __all__ = [
-    "checkpoint", "save_state_dict", "load_state_dict",
+    "checkpoint", "save_state_dict", "load_state_dict", "launch",
     # pipeline
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     # context parallel

@@ -78,6 +78,12 @@ def init_parallel_env(dp_degree: Optional[int] = None, mp_degree: int = 1,
 
     coord = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
     if coord and not _MULTIHOST_INITIALIZED:
+        if os.environ.get("PADDLE_TPU_BACKEND") == "cpu":
+            # launcher --backend cpu (tests / multi-host emulation): pin the
+            # CPU platform through the config API (the axon sitecustomize
+            # pins JAX_PLATFORMS) and use gloo for cross-process collectives
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=num_processes or int(os.environ["NUM_PROCESSES"]),

@@ -1,0 +1,39 @@
+"""CLI: ``python -m paddle_tpu.distributed.launch --nprocs N train.py ...``
+
+Parity: ``python -m paddle.distributed.launch`` (upstream layout:
+python/paddle/distributed/launch/main.py).
+"""
+
+import argparse
+import sys
+
+from . import LaunchConfig, launch
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    ap.add_argument("--nprocs", type=int, default=1,
+                    help="worker processes (one per host in production; "
+                    "many-per-host for cpu-backend testing)")
+    ap.add_argument("--master", default=None,
+                    help="coordinator host:port (default: local free port)")
+    ap.add_argument("--backend", choices=("tpu", "cpu"), default="tpu")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="elastic: restart the job this many times on "
+                    "worker failure (resume from checkpoints)")
+    ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--devices-per-proc", type=int, default=None,
+                    help="cpu backend: virtual device count per process")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    cfg = LaunchConfig(nprocs=args.nprocs, master=args.master,
+                       backend=args.backend, max_restarts=args.max_restarts,
+                       log_dir=args.log_dir,
+                       devices_per_proc=args.devices_per_proc)
+    return launch(args.script, args.script_args, cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Elastic worker: trains a trivial counter with per-step checkpoints,
+crashes rank 1 once, and resumes from the latest checkpoint on restart.
+
+The supervisor (paddle_tpu.distributed.launch elastic_run) must detect the
+death, tear the group down, and respawn with PADDLE_TPU_RESTART_NUM=1; the
+second incarnation resumes from step >= 2 and completes.  Prints
+"DONE start=<resume_step>" on success.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import checkpoint as ckpt
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+TOTAL_STEPS = 4
+
+
+def latest_step(workdir):
+    marker = os.path.join(workdir, "latest.txt")
+    if not os.path.exists(marker):
+        return None
+    with open(marker) as f:
+        return int(f.read().strip())
+
+
+def main():
+    workdir = sys.argv[1]
+    restart = int(os.environ["PADDLE_TPU_RESTART_NUM"])
+    hcg = dist.init_parallel_env()
+    proc = jax.process_index()
+    mesh = hcg.mesh
+
+    last = latest_step(workdir)
+    if last is None:
+        start, w = 0, np.zeros((4, 2), np.float32)
+    else:
+        start = last + 1
+        state = ckpt.load_state_dict(os.path.join(workdir, f"step{last}"))
+        w = np.asarray(state["w"])
+
+    for step in range(start, TOTAL_STEPS):
+        w = w + 1.0  # the "train step"
+        sharded = jax.device_put(w, NamedSharding(mesh, P("dp")))
+        ckpt.save_state_dict({"w": sharded},
+                             os.path.join(workdir, f"step{step}"))
+        multihost_utils.sync_global_devices(f"step{step}")
+        if proc == 0:
+            tmp = os.path.join(workdir, "latest.txt.tmp")
+            with open(tmp, "w") as f:
+                f.write(str(step))
+            os.replace(tmp, os.path.join(workdir, "latest.txt"))
+        multihost_utils.sync_global_devices(f"step{step}_marked")
+        if restart == 0 and step == 1 and proc == 1:
+            os._exit(17)  # simulated hardware failure after step-1 ckpt
+
+    assert np.allclose(w, float(TOTAL_STEPS)), w
+    print(f"DONE start={start} proc={proc}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
