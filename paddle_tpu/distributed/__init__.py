@@ -29,12 +29,13 @@ from . import moe
 from .context_parallel import context_parallel_attention
 from .moe import GShardGate, MoELayer, SwitchGate
 from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,
-                       SharedLayerDesc)
+                       PipelineParallelWithInterleave, SharedLayerDesc)
 
 __all__ = [
     "checkpoint", "save_state_dict", "load_state_dict", "launch",
     # pipeline
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    "PipelineParallelWithInterleave",
     # context parallel
     "context_parallel_attention",
     # moe

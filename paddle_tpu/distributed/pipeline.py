@@ -41,7 +41,7 @@ from . import env
 from .topology import AXIS_ORDER
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel"]
+           "PipelineParallel", "PipelineParallelWithInterleave"]
 
 
 class LayerDesc:
@@ -143,7 +143,8 @@ class PipelineLayer(Layer):
 
     def __init__(self, layer_descs: Sequence[LayerDesc], num_stages: int,
                  loss_fn: Optional[Callable] = None, hcg=None,
-                 partition: Optional[List[Tuple[int, int]]] = None):
+                 partition: Optional[List[Tuple[int, int]]] = None,
+                 num_virtual_pipeline_stages: int = 1):
         super().__init__()
         self.loss_fn = loss_fn
         h = hcg or env.hybrid_group()
@@ -154,35 +155,42 @@ class PipelineLayer(Layer):
             raise ValueError(f"num_stages={num_stages} != mesh pp degree "
                              f"{h.degree('pp')}")
         self.num_stages = num_stages
+        self.num_virtual_stages = num_virtual_pipeline_stages
+        # interleave (Megatron virtual stages, parity:
+        # PipelineParallelWithInterleave): the desc list is cut into
+        # S*V chunks; chunk c lives on physical stage c % S, so each
+        # physical stage holds V non-contiguous model chunks.
+        n_chunks = num_stages * num_virtual_pipeline_stages
         self.descs = list(layer_descs)
         if partition is None:
             n = len(self.descs)
-            base, extra = divmod(n, num_stages)
+            base, extra = divmod(n, n_chunks)
             partition = []
             start = 0
-            for s in range(num_stages):
+            for s in range(n_chunks):
                 stop = start + base + (1 if s < extra else 0)
                 partition.append((start, stop))
                 start = stop
         self.partition = partition
 
-        # one sub-mesh per stage: fix the pp coordinate, keep other axes
+        # one sub-mesh per physical stage: fix the pp coordinate
         full = h.mesh.devices  # shape (pp, dp, sharding, sep, mp)
         axes = tuple(a for a in AXIS_ORDER if a != "pp")
+        self._submeshes = [Mesh(full[s], axes) for s in range(num_stages)]
         self._shared: Dict[str, List[Tuple[int, Layer]]] = {}
         self.stages: List[_Stage] = []
-        for s in range(num_stages):
-            sub = Mesh(full[s], axes)
+        for c in range(n_chunks):
+            sub = self._submeshes[c % num_stages]
             layers = []
-            for d in self.descs[partition[s][0]:partition[s][1]]:
+            for d in self.descs[partition[c][0]:partition[c][1]]:
                 layer = d.build()
                 if isinstance(d, SharedLayerDesc):
                     self._shared.setdefault(d.shared_key, []).append(
-                        (s, layer))
+                        (c, layer))
                 layers.append(layer)
             self.stages.append(_Stage(
-                s, layers, sub,
-                loss_fn=loss_fn if s == num_stages - 1 else None))
+                c, layers, sub,
+                loss_fn=loss_fn if c == n_chunks - 1 else None))
         self._tie_shared()
 
     def _tie_shared(self):
@@ -235,13 +243,19 @@ class PipelineParallel:
     """
 
     def __init__(self, layers: PipelineLayer, optimizer=None,
-                 accumulate_steps: int = 1, schedule: str = "1F1B"):
+                 accumulate_steps: int = 1, schedule: str = "1F1B",
+                 zero_stage: Optional[int] = None):
         if schedule not in ("1F1B", "FThenB"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.layers = layers
         self.optimizer = optimizer
         self.accumulate_steps = accumulate_steps
         self.schedule = schedule
+        if zero_stage is None:  # from the fleet strategy, like the GSPMD path
+            from . import fleet as fleet_mod
+            s = fleet_mod.get_strategy()
+            zero_stage = s.sharding.stage if s is not None else 1
+        self.zero_stage = zero_stage
         self._opt_states: Optional[List[Any]] = None
 
     # -- helpers ------------------------------------------------------------
@@ -337,30 +351,42 @@ class PipelineParallel:
 
     # -- shared-weight grad sync + optimizer --------------------------------
 
-    def _allreduce_shared(self, grads_acc):
-        """Sum grads of tied weights across stages and mirror them (the
-        reference's shared-embedding allreduce over the embed group)."""
+    def _shared_names(self):
+        """shared_key -> [(stage_idx, [param names in stage module])]."""
+        out = {}
         for key in self.layers.shared_groups:
             members = self.layers._shared[key]
-            # map: stage -> {param_name_in_stage_module: grad}
-            names = {}
+            entries = []
             for s, layer in members:
                 prefix = _find_prefix(self.layers.stages[s].module, layer)
-                names[s] = [prefix + n for n, p in
-                            layer.named_parameters() if p.trainable]
-            total = None
-            for s, _ in members:
-                part = {n: grads_acc[s][n] for n in names[s]
-                        if grads_acc[s] is not None and n in grads_acc[s]}
-                vals = [np.asarray(v) for v in part.values()]
-                total = vals if total is None else \
-                    [a + b for a, b in zip(total, vals)]
-            if total is None:
+                entries.append((s, [prefix + n for n, p in
+                                    layer.named_parameters() if p.trainable]))
+            out[key] = entries
+        return out
+
+    def _allreduce_shared(self, grads_acc):
+        """Sum grads of tied weights across stages and mirror them (the
+        reference's shared-embedding allreduce over the embed group).
+
+        Fully device-side: cross-stage hops are ``jax.device_put`` between
+        sub-meshes (ICI/DCN p2p) and the sums are jitted adds — no host
+        round trip, so the 1F1B async overlap survives the sync.
+        """
+        for key, entries in self._shared_names().items():
+            entries = [(s, names) for s, names in entries
+                       if grads_acc[s] is not None]
+            if len(entries) < 2:
                 continue
-            for s, _ in members:
-                for n, v in zip(names[s], total):
+            owner_s, owner_names = entries[0]
+            totals = [grads_acc[owner_s][n] for n in owner_names]
+            for s, names in entries[1:]:
+                moved = [jax.device_put(grads_acc[s][n], t.sharding)
+                         for n, t in zip(names, totals)]
+                totals = [_jit_add(t, m) for t, m in zip(totals, moved)]
+            for s, names in entries:
+                for n, t in zip(names, totals):
                     grads_acc[s][n] = jax.device_put(
-                        jnp.asarray(v), grads_acc[s][n].sharding)
+                        t, grads_acc[s][n].sharding)
 
     def _apply(self, opt, grads_acc):
         from .parallelize import optimizer_state_shardings
@@ -371,8 +397,8 @@ class PipelineParallel:
             self._update_jit = []
             for st in stages:
                 state = opt.init(st.params)
-                shard = optimizer_state_shardings(state, st.module, st.mesh,
-                                                  zero_stage=1)
+                shard = optimizer_state_shardings(
+                    state, st.module, st.mesh, zero_stage=self.zero_stage)
                 self._opt_states.append(jax.tree.map(jax.device_put, state,
                                                      shard))
                 self._update_jit.append(jax.jit(opt.update))
@@ -384,15 +410,51 @@ class PipelineParallel:
             stage.params = new_params
             stage.module.set_state_dict(new_params, strict=False)
         # re-sync tied weights (identical update given identical grads, but
-        # floating-point order can drift; copy from the owner)
-        for key in self.layers.shared_groups:
-            members = self.layers._shared[key]
-            (s0, first) = members[0]
-            src = first.state_dict(include_buffers=False)
-            for s, layer in members[1:]:
-                layer.set_state_dict(
-                    {k: np.asarray(v) for k, v in src.items()}, strict=False)
-                stages[s].params = stages[s].module.trainable_state()
+        # floating-point order can drift): device-side copy from the owner
+        # stage — a sub-mesh-to-sub-mesh transfer, no host bounce
+        for key, entries in self._shared_names().items():
+            owner_s, owner_names = entries[0]
+            for s, names in entries[1:]:
+                updates = {}
+                for n_owner, n in zip(owner_names, names):
+                    updates[n] = jax.device_put(
+                        stages[owner_s].params[n_owner],
+                        stages[s].params[n].sharding)
+                stages[s].params.update(updates)
+                stages[s].module.set_state_dict(updates, strict=False)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved 1F1B over virtual stages (parity: fleet's
+    PipelineParallelWithInterleave).
+
+    Requires a :class:`PipelineLayer` built with
+    ``num_virtual_pipeline_stages > 1``: the model is cut into S·V chunks,
+    chunk c on physical stage c % S, so each microbatch visits every
+    physical stage V times.  The driver enqueues in 1F1B order at chunk
+    depth (warmup = chunks-1) — with async device dispatch the physical
+    stages overlap across chunks, shrinking the bubble by ~1/V like the
+    reference's schedule.
+    """
+
+    def __init__(self, layers: PipelineLayer, optimizer=None,
+                 accumulate_steps: int = 1, zero_stage: Optional[int] = None):
+        if layers.num_virtual_stages < 2:
+            raise ValueError(
+                "PipelineParallelWithInterleave needs a PipelineLayer with "
+                "num_virtual_pipeline_stages >= 2")
+        super().__init__(layers, optimizer=optimizer,
+                         accumulate_steps=accumulate_steps,
+                         schedule="1F1B", zero_stage=zero_stage)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_add_cached():
+    return jax.jit(jnp.add)
+
+
+def _jit_add(a, b):
+    return _jit_add_cached()(a, b)
 
 
 def _tree_add(acc, new):
