@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..nn import functional as F
+from ..tensor.math import einsum
 from ..nn import initializer as I
 from ..nn.layer import Layer
 from .fleet.mp_layers import constrain
@@ -176,9 +177,9 @@ class MoELayer(Layer):
 
     def _expert(self, x):
         """Apply all experts: x (E, C, D) → (E, C, D)."""
-        g = jnp.einsum("ecd,edf->ecf", x, self.gate_proj)
-        u = jnp.einsum("ecd,edf->ecf", x, self.up_proj)
-        return jnp.einsum("ecf,efd->ecd", F.swiglu(g, u), self.down_proj)
+        g = einsum("ecd,edf->ecf", x, self.gate_proj)
+        u = einsum("ecd,edf->ecf", x, self.up_proj)
+        return einsum("ecf,efd->ecd", F.swiglu(g, u), self.down_proj)
 
     def forward(self, x):
         """x: (..., D) → (out (..., D), aux_loss scalar)."""
@@ -188,9 +189,9 @@ class MoELayer(Layer):
         disp, combine, aux = self._route(logits)
         # dispatch: (T,E,C) × (T,D) → (E,C,D); XLA emits the alltoall when
         # T is batch-sharded and E is expert-sharded
-        xe = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), xt)
+        xe = einsum("tec,td->ecd", disp.astype(x.dtype), xt)
         xe = constrain(xe, EP_AXES, None, None)
         ye = self._expert(xe)
         ye = constrain(ye, EP_AXES, None, None)
-        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)
+        out = einsum("tec,ecd->td", combine.astype(x.dtype), ye)
         return out.reshape(shape), aux

@@ -121,7 +121,7 @@ def build_train_step(model: Layer, optimizer,
                      loss_fn: Callable[[Layer, Dict[str, Any]], Any] = None,
                      hcg=None, zero_stage: Optional[int] = None,
                      grad_accum_steps: int = 1,
-                     donate: bool = True):
+                     donate: bool = True, scaler=None):
     """Build the hybrid-parallel train step.
 
     Returns ``(step_fn, params, opt_state)`` where
@@ -134,6 +134,16 @@ def build_train_step(model: Layer, optimizer,
     :func:`shard_batch`.  ``grad_accum_steps > 1`` runs a ``lax.scan``
     microbatch loop accumulating fp32 grads (the reference's gradient-merge
     pass / ``accumulate_steps``).
+
+    ``scaler`` = an enabled :class:`paddle_tpu.amp.GradScaler` compiles its
+    functional core INTO the step (fp16 path): loss scaled before grad,
+    grads unscaled, a non-finite grad skips the whole update and shrinks the
+    scale — all under jit, no host sync (the reference's check_finite +
+    update-skipping in GradScaler.minimize).  The scaler state rides inside
+    ``opt_state`` (key ``"grad_scaler"``).
+
+    The ``check_nan_inf`` debug flag (parity: FLAGS_check_nan_inf) raises
+    ``FloatingPointError`` from the step when any grad goes non-finite.
     """
     mesh = _mesh(hcg)
     if zero_stage is None:
@@ -141,6 +151,7 @@ def build_train_step(model: Layer, optimizer,
         s = fleet_mod.get_strategy()
         zero_stage = s.sharding.stage if s is not None else 1
     loss_fn = loss_fn or _default_loss_fn
+    use_scaler = scaler is not None and scaler.is_enable()
 
     p_shard = param_shardings(model, mesh)
     params = {k: jax.device_put(v, p_shard[k])
@@ -148,19 +159,34 @@ def build_train_step(model: Layer, optimizer,
     opt_state = optimizer.init(params)
     o_shard = optimizer_state_shardings(opt_state, model, mesh, zero_stage)
     opt_state = jax.tree.map(jax.device_put, opt_state, o_shard)
+    if use_scaler:
+        sc_state = scaler.init_state()
+        opt_state = {"opt": opt_state, "grad_scaler": sc_state}
+        o_shard = {"opt": o_shard,
+                   "grad_scaler": jax.tree.map(
+                       lambda _: NamedSharding(mesh, P()), sc_state)}
 
-    def call_loss(p, batch, rng):
+    from ..flags import flag as _flag
+    check_nan = bool(_flag("check_nan_inf"))
+
+    def call_loss(p, batch, rng, sc):
         with bind_params(model, p, rng=rng):
-            return loss_fn(model, batch)
+            loss = loss_fn(model, batch)
+        if use_scaler:
+            return scaler.scale_with(sc, loss), loss
+        return loss, loss
 
     def step(p, o, batch, rng):
+        sc = o["grad_scaler"] if use_scaler else None
+        o_inner = o["opt"] if use_scaler else o
         if grad_accum_steps == 1:
-            loss, grads = jax.value_and_grad(call_loss)(p, batch, rng)
+            (_, loss), grads = jax.value_and_grad(
+                call_loss, has_aux=True)(p, batch, rng, sc)
         else:
             def micro(carry, mb):
                 acc, i = carry
-                l, g = jax.value_and_grad(call_loss)(
-                    p, mb, jax.random.fold_in(rng, i))
+                (_, l), g = jax.value_and_grad(call_loss, has_aux=True)(
+                    p, mb, jax.random.fold_in(rng, i), sc)
                 acc = jax.tree.map(
                     lambda a, b: a + b.astype(jnp.float32) / grad_accum_steps,
                     acc, g)
@@ -173,13 +199,42 @@ def build_train_step(model: Layer, optimizer,
                                     + v.shape[1:]), batch)
             (grads, _), losses = jax.lax.scan(micro, (zeros, 0), mbs)
             loss = jnp.mean(losses)
-        new_p, new_o = optimizer.update(grads, o, p)
+        if use_scaler:
+            grads, found_inf = scaler.unscale_with(sc, grads)
+        if check_nan:
+            _raise_on_nonfinite(grads)
+        new_p, new_o = optimizer.update(grads, o_inner, p)
+        if use_scaler:
+            # found_inf → keep old params AND old optimizer state (the
+            # update, including its step counter, never happened)
+            new_p = jax.tree.map(
+                lambda old, new: jnp.where(found_inf, old, new), p, new_p)
+            new_o = jax.tree.map(
+                lambda old, new: jnp.where(found_inf, old, new),
+                o_inner, new_o)
+            new_o = {"opt": new_o,
+                     "grad_scaler": scaler.update_state(sc, found_inf)}
         return loss, new_p, new_o
 
     step_jit = jax.jit(step, donate_argnums=(0, 1) if donate else (),
                        out_shardings=(NamedSharding(mesh, P()), p_shard,
                                       o_shard))
     return step_jit, params, opt_state
+
+
+def _raise_on_nonfinite(grads):
+    """check_nan_inf debug hook: host callback raising FloatingPointError."""
+    flat = jax.tree.leaves(grads)
+    bad = jnp.zeros((), jnp.bool_)
+    for g in flat:
+        bad = bad | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+
+    def cb(b):
+        if bool(b):
+            raise FloatingPointError(
+                "check_nan_inf: non-finite gradient detected")
+
+    jax.debug.callback(cb, bad)
 
 
 def build_eval_step(model: Layer, hcg=None, fn: Optional[Callable] = None):

@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..distributed.fleet.mp_layers import constrain
 from ..nn import functional as F
+from ..tensor.math import matmul
 from ..nn import initializer as I
 from ..nn.common import RMSNorm
 from ..nn.layer import Layer
@@ -139,9 +140,12 @@ class LlamaAttention(Layer):
     def forward(self, x, rope_cache, position_ids=None, kv_cache=None):
         c = self.config
         b, s, _ = x.shape
-        q = (x @ self.q_proj).reshape(b, s, c.num_attention_heads, c.head_dim)
-        k = (x @ self.k_proj).reshape(b, s, c.num_key_value_heads, c.head_dim)
-        v = (x @ self.v_proj).reshape(b, s, c.num_key_value_heads, c.head_dim)
+        q = matmul(x, self.q_proj).reshape(b, s, c.num_attention_heads,
+                                           c.head_dim)
+        k = matmul(x, self.k_proj).reshape(b, s, c.num_key_value_heads,
+                                           c.head_dim)
+        v = matmul(x, self.v_proj).reshape(b, s, c.num_key_value_heads,
+                                           c.head_dim)
         cos, sin = rope_cache
         q, k = fused_rope(q, k, cos, sin, position_ids)
         if kv_cache is not None:  # decode path: append to cache
@@ -163,7 +167,7 @@ class LlamaAttention(Layer):
             k = constrain(k, ("dp", "sharding"), None, "mp", None)
             v = constrain(v, ("dp", "sharding"), None, "mp", None)
             out = flash_attention(q, k, v, causal=True)
-        out = out.reshape(b, s, -1) @ self.o_proj
+        out = matmul(out.reshape(b, s, -1), self.o_proj)
         if kv_cache is not None:
             return out, kv_cache
         return out
@@ -190,7 +194,9 @@ class LlamaMLP(Layer):
             attr_name="down_proj")
 
     def forward(self, x):
-        return F.swiglu(x @ self.gate_proj, x @ self.up_proj) @ self.down_proj
+        return matmul(F.swiglu(matmul(x, self.gate_proj),
+                               matmul(x, self.up_proj)),
+                      self.down_proj)
 
 
 class LlamaDecoderLayer(Layer):
@@ -277,8 +283,8 @@ class LlamaForCausalLM(Layer):
     def logits(self, hidden):
         if self.config.tie_word_embeddings:
             w = self.model.embed_tokens
-            return hidden @ w.T
-        return hidden @ self.lm_head
+            return matmul(hidden, w.T)
+        return matmul(hidden, self.lm_head)
 
     def forward(self, input_ids, position_ids=None):
         return self.logits(self.model(input_ids, position_ids))
@@ -343,7 +349,7 @@ class LlamaHeadPipe(Layer):
             sharding=P("sharding", "mp"), attr_name="lm_head")
 
     def forward(self, x):
-        return self.norm(x) @ self.lm_head
+        return matmul(self.norm(x), self.lm_head)
 
 
 def llama_pipe_descs(config: LlamaConfig):

@@ -15,7 +15,7 @@ __all__ = [
     "add", "subtract", "multiply", "divide", "floor_divide", "mod",
     "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
     "heaviside", "lerp", "outer", "inner", "cross", "dot", "matmul", "mm",
-    "bmm", "mv", "add_n",
+    "bmm", "mv", "add_n", "einsum",
     # unary
     "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
     "square", "reciprocal", "abs", "neg", "sign", "floor", "ceil", "round",
@@ -109,11 +109,25 @@ def dot(x, y):
 
 
 def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    """AMP-aware matmul: under an ``amp.auto_cast`` O1 policy the operands
+    are cast to the policy dtype (the reference's white-list dispatch in
+    eager amp_utils; models route their projections through here so O1 is
+    real, not decorative)."""
+    from .. import amp as _amp
+    x, y = _amp.cast_inputs("matmul", x, y)
     if transpose_x:
         x = jnp.swapaxes(x, -1, -2)
     if transpose_y:
         y = jnp.swapaxes(y, -1, -2)
     return jnp.matmul(x, y)
+
+
+def einsum(equation, *operands):
+    """AMP-aware einsum (white-listed: it is the MoE dispatch/combine and
+    attention workhorse)."""
+    from .. import amp as _amp
+    operands = _amp.cast_inputs("einsum", *operands)
+    return jnp.einsum(equation, *operands)
 
 
 def mm(x, y):
