@@ -1,0 +1,73 @@
+"""paddle_tpu.inference — the deployment wrapper.
+
+TPU-native equivalent of the reference's inference engine surface (upstream
+layout: paddle/fluid/inference/api/ — ``paddle_infer::Config`` +
+``AnalysisPredictor``; Python binding ``paddle.inference.create_predictor``).
+The engine itself is XLA: the analysis passes / TensorRT subgraphing the
+reference runs at load time are what XLA already did at export time, so the
+Predictor is a thin runner over a :mod:`paddle_tpu.jit` artifact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .. import jit as _jit
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """Parity: paddle_infer.Config (model dir + runtime knobs)."""
+
+    def __init__(self, model_dir: Optional[str] = None):
+        self.model_dir = model_dir
+        self._device = None
+
+    def set_model(self, model_dir: str):
+        self.model_dir = model_dir
+
+    def enable_use_gpu(self, *a, **k):  # reference API shims: device
+        self._device = "accelerator"    # choice is jax's; calls are no-ops
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+
+class Predictor:
+    """Minimal run loop over an AOT artifact (parity: AnalysisPredictor:
+    named input binding -> run -> named outputs)."""
+
+    def __init__(self, config: Config):
+        if not config.model_dir:
+            raise ValueError("Config.model_dir not set")
+        self._layer = _jit.load(config.model_dir)
+        specs = self._layer.input_specs
+        self._names = [s.get("name") or f"input_{i}"
+                       for i, s in enumerate(specs)]
+        self._feed: Dict[str, Any] = {}
+        self._out: Optional[Sequence[Any]] = None
+
+    # -- named-handle API (reference style) ---------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._names)
+
+    def set_input(self, name: str, value):
+        self._feed[name] = value
+
+    def run(self, inputs: Optional[Sequence[Any]] = None):
+        if inputs is None:
+            inputs = [self._feed[n] for n in self._names]
+        out = self._layer(*[np.asarray(x) for x in inputs])
+        self._out = jax.tree.leaves(out)
+        return [np.asarray(o) for o in self._out]
+
+    def get_output_names(self) -> List[str]:
+        return [f"output_{i}" for i in range(len(self._out or []))]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
