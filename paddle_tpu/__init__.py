@@ -12,8 +12,8 @@ analysis), designed jax/XLA/Pallas/pjit-first rather than ported:
   * ProcessGroupNCCL/TCPStore ≙ jax.distributed + XLA collectives over ICI/DCN
 """
 
-from . import (amp, distributed, flags, framework, inference, io, jit,
-               nn, optimizer, profiler, tensor)
+from . import (amp, distributed, flags, framework, hapi, inference, io,
+               jit, metric, nn, optimizer, profiler, tensor, utils)
 from .framework import (device_count, get_default_dtype, is_compiled_with_tpu,
                         load, save, seed, set_default_dtype, to_tensor)
 from .flags import get_flags, set_flags
@@ -21,12 +21,14 @@ from .flags import get_flags, set_flags
 # ``paddle.concat``/``paddle.matmul`` (upstream python/paddle/__init__.py)
 from .tensor import *  # noqa: F401,F403
 from .tensor import Tensor, __all__ as _tensor_all
+from .hapi import Model
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "amp", "distributed", "flags", "framework", "inference", "io", "jit",
-    "nn", "optimizer", "profiler", "tensor",
+    "amp", "distributed", "flags", "framework", "hapi", "inference", "io",
+    "jit", "metric", "nn", "optimizer", "profiler", "tensor", "utils",
+    "Model",
     "seed", "to_tensor", "device_count", "is_compiled_with_tpu",
     "get_default_dtype", "set_default_dtype", "get_flags", "set_flags",
     "save", "load", "__version__",

@@ -1,0 +1,167 @@
+"""paddle_tpu.hapi — the high-level Model.fit API.
+
+Parity surface: upstream python/paddle/hapi/model.py (``paddle.Model`` with
+``prepare``/``fit``/``evaluate``/``predict``/``save``/``load`` + the
+callback protocol).  TPU-first internals: one jitted train step over the
+functional bridge (params as an explicit pytree, donated each step) instead
+of the reference's per-op eager dispatch — the fit loop is host-side
+bookkeeping around a compiled step, which is the shape every jax training
+loop wants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer, bind_params
+from . import callbacks as callbacks_mod
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model", "callbacks"]
+
+callbacks = callbacks_mod
+
+
+class Model:
+    """``Model(network)`` → ``prepare(optimizer, loss, metrics)`` →
+    ``fit/evaluate/predict`` (parity: paddle.Model)."""
+
+    def __init__(self, network: Layer):
+        self.network = network
+        self.optimizer = None
+        self.loss = None
+        self.metrics: List = []
+        self.stop_training = False
+        self._params: Optional[Dict[str, Any]] = None
+        self._opt_state = None
+        self._train_step = None
+        self._rng = jax.random.key(0)
+
+    # -- setup ---------------------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self.optimizer = optimizer
+        self.loss = loss
+        ms = metrics if metrics is not None else []
+        self.metrics = ms if isinstance(ms, (list, tuple)) else [ms]
+        self._params = self.network.trainable_state()
+        if optimizer is not None:
+            self._opt_state = optimizer.init(self._params)
+        if loss is not None and optimizer is not None:
+            self._train_step = self._build_step()
+        return self
+
+    def _build_step(self):
+        net, loss_fn, opt = self.network, self.loss, self.optimizer
+
+        def call_loss(p, x, y, rng):
+            with bind_params(net, p, rng=rng):
+                return loss_fn(net(x), y)
+
+        def step(p, o, x, y, rng):
+            loss, grads = jax.value_and_grad(call_loss)(p, x, y, rng)
+            new_p, new_o = opt.update(grads, o, p)
+            return loss, new_p, new_o
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # -- loops ---------------------------------------------------------------
+
+    def _sync_network(self):
+        self.network.set_state_dict(self._params, strict=False)
+
+    def fit(self, train_data, eval_data=None, epochs: int = 1,
+            verbose: int = 1, callbacks: Optional[List[Callback]] = None,
+            log_freq: int = 10):
+        if self._train_step is None:
+            raise RuntimeError("call prepare(optimizer, loss) before fit()")
+        cbs = CallbackList(list(callbacks or []))
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        cbs.set_model(self)
+        cbs.set_params({"epochs": epochs, "verbose": verbose})
+        self.stop_training = False
+        cbs.on_train_begin()
+        logs: Dict[str, Any] = {}
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            losses = []
+            for i, (x, y) in enumerate(train_data):
+                cbs.on_train_batch_begin(i)
+                self._rng, sub = jax.random.split(self._rng)
+                loss, self._params, self._opt_state = self._train_step(
+                    self._params, self._opt_state, jnp.asarray(x),
+                    jnp.asarray(y), sub)
+                losses.append(float(loss))
+                logs = {"loss": losses[-1]}
+                cbs.on_train_batch_end(i, logs)
+            logs = {"loss": float(np.mean(losses))}
+            if eval_data is not None:
+                logs.update(self.evaluate(eval_data, verbose=0,
+                                          _inside_fit=True))
+            cbs.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        self._sync_network()
+        cbs.on_train_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, verbose: int = 0, _inside_fit=False):
+        for m in self.metrics:
+            m.reset()
+        losses = []
+        params = self._params or self.network.state_dict(
+            include_buffers=True)
+        for x, y in eval_data:
+            out = self._forward(params, jnp.asarray(x))
+            if self.loss is not None:
+                losses.append(float(self.loss(out, jnp.asarray(y))))
+            for m in self.metrics:
+                m.update(m.compute(out, y))
+        logs = {}
+        if losses:
+            logs["eval_loss" if _inside_fit else "loss"] = float(
+                np.mean(losses))
+        for m in self.metrics:
+            names, vals = m.name(), m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data):
+        params = self._params or self.network.state_dict(
+            include_buffers=True)
+        outs = []
+        for batch in test_data:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outs.append(np.asarray(self._forward(params, jnp.asarray(x))))
+        return outs
+
+    def _forward(self, params, x):
+        with bind_params(self.network, params, eval_mode=True):
+            return self.network(x)
+
+    # -- io ------------------------------------------------------------------
+
+    def opt_state_dict(self):
+        return self._opt_state
+
+    def save(self, path: str):
+        from ..framework import io as _io
+        self._sync_network()
+        _io.save(self.network.state_dict(), path + ".pdparams")
+        if self._opt_state is not None:
+            _io.save(self._opt_state, path + ".pdopt")
+
+    def load(self, path: str):
+        from ..framework import io as _io
+        state = _io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        self._params = self.network.trainable_state()
+        import os
+        if os.path.exists(path + ".pdopt") and self.optimizer is not None:
+            self._opt_state = _io.load(path + ".pdopt")

@@ -1,0 +1,190 @@
+"""hapi callbacks (parity surface: upstream python/paddle/hapi/callbacks.py).
+
+``Callback`` hook points match the reference's names so user callbacks port
+directly; the built-ins cover the common loop furniture: progress logging,
+checkpointing, LR stepping, early stop.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "LRSchedulerCallback", "EarlyStopping"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params: Dict[str, Any] = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_begin(self, logs=None):
+        ...
+
+    def on_train_end(self, logs=None):
+        ...
+
+    def on_epoch_begin(self, epoch, logs=None):
+        ...
+
+    def on_epoch_end(self, epoch, logs=None):
+        ...
+
+    def on_train_batch_begin(self, step, logs=None):
+        ...
+
+    def on_train_batch_end(self, step, logs=None):
+        ...
+
+    def on_eval_begin(self, logs=None):
+        ...
+
+    def on_eval_end(self, logs=None):
+        ...
+
+    def on_eval_batch_begin(self, step, logs=None):
+        ...
+
+    def on_eval_batch_end(self, step, logs=None):
+        ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb: Callback):
+        self.callbacks.append(cb)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def __getattr__(self, name):
+        if not name.startswith("on_"):
+            raise AttributeError(name)
+
+        def fanout(*args, **kwargs):
+            for cb in self.callbacks:
+                getattr(cb, name)(*args, **kwargs)
+        return fanout
+
+
+class ProgBarLogger(Callback):
+    """Step/epoch console logging (parity: hapi's ProgBarLogger, minus the
+    terminal animation — log lines, not control codes)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 1):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        if self.verbose:
+            total = self.params.get("epochs")
+            print(f"Epoch {epoch + 1}/{total}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in
+                               (logs or {}).items()
+                               if isinstance(v, (int, float)))
+            print(f"  step {step}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in
+                               (logs or {}).items()
+                               if isinstance(v, (int, float)))
+            print(f"  epoch {epoch + 1} done in {dt:.1f}s - {items}")
+
+
+class ModelCheckpoint(Callback):
+    """Save the model each ``save_freq`` epochs (parity: hapi's
+    ModelCheckpoint layout: <dir>/<epoch>.pdparams + final.pdparams)."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "./checkpoints"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def _save(self, tag: str):
+        os.makedirs(self.save_dir, exist_ok=True)
+        # Model.save syncs the live (possibly donated-and-replaced) param
+        # pytree back into the network before writing
+        self.model.save(os.path.join(self.save_dir, tag))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch % self.save_freq == 0:
+            self._save(str(epoch))
+
+    def on_train_end(self, logs=None):
+        self._save("final")
+
+
+class LRSchedulerCallback(Callback):
+    """Step the LR scheduler each epoch or batch (parity: hapi LRScheduler)."""
+
+    def __init__(self, by_step: bool = False, by_epoch: bool = True):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        lr = getattr(self.model.optimizer, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop when ``monitor`` stops improving (parity: hapi EarlyStopping)."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "min",
+                 patience: int = 0, min_delta: float = 0.0,
+                 baseline: Optional[float] = None):
+        super().__init__()
+        self.monitor = monitor
+        self.sign = -1.0 if mode == "min" else 1.0
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.best = baseline
+        self.wait = 0
+        self.stopped_epoch: Optional[int] = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        score = self.sign * float(cur)
+        if self.best is None or score > self.sign * self.best + self.min_delta:
+            self.best = float(cur)
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait > self.patience:
+            self.stopped_epoch = epoch
+            self.model.stop_training = True

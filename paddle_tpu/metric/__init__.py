@@ -1,0 +1,171 @@
+"""paddle_tpu.metric — streaming metrics.
+
+Parity surface: upstream python/paddle/metric/metrics.py (``Metric`` base
+with update/accumulate/reset/name, ``Accuracy``, ``Precision``, ``Recall``,
+``Auc``).  Accumulation is host-side numpy over per-batch device results —
+metrics are observability, not a compute path, so they stay off the jit
+graph (matching the reference, whose metrics run in Python on fetched
+outputs).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Union
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+class Metric(abc.ABC):
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, pred, label):
+        """Optional pre-processing hook (runs on device outputs)."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (parity: paddle.metric.Accuracy)."""
+
+    def __init__(self, topk: Union[int, Sequence[int]] = (1,),
+                 name: str = "acc"):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name
+        self.reset()
+
+    def compute(self, pred, label):
+        pred = np.asarray(pred)
+        label = np.asarray(label)
+        maxk = max(self.topk)
+        order = np.argsort(-pred, axis=-1)[..., :maxk]
+        if label.ndim == pred.ndim:  # one-hot / soft labels
+            label = np.argmax(label, axis=-1)
+        correct = order == label[..., None]
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        n = int(np.prod(correct.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            self._correct[i] += int(correct[..., :k].any(-1).sum())
+        self._total += n
+        return self.accumulate()
+
+    def accumulate(self):
+        accs = [(c / self._total if self._total else 0.0)
+                for c in self._correct]
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self._correct = [0] * len(self.topk)
+        self._total = 0
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (parity: paddle.metric.Precision)."""
+
+    def __init__(self, name: str = "precision"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).ravel() > 0.5)
+        labels = np.asarray(labels).ravel().astype(bool)
+        self.tp += int((preds & labels).sum())
+        self.fp += int((preds & ~labels).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def name(self):
+        return [self._name]
+
+
+class Recall(Metric):
+    """Binary recall (parity: paddle.metric.Recall)."""
+
+    def __init__(self, name: str = "recall"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).ravel() > 0.5)
+        labels = np.asarray(labels).ravel().astype(bool)
+        self.tp += int((preds & labels).sum())
+        self.fn += int((~preds & labels).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def name(self):
+        return [self._name]
+
+
+class Auc(Metric):
+    """ROC AUC via threshold histogram (parity: paddle.metric.Auc's
+    bucketed trapezoid estimate)."""
+
+    def __init__(self, num_thresholds: int = 4095, name: str = "auc"):
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.ravel()
+        labels = np.asarray(labels).ravel().astype(bool)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx, labels)
+        np.add.at(self._neg, idx, ~labels)
+
+    def accumulate(self):
+        # sweep thresholds high→low: cumulative TP/FP counts
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        P, N = tp[-1], fp[-1]
+        if P == 0 or N == 0:
+            return 0.0
+        tpr = tp / P
+        fpr = fp / N
+        return float(np.trapezoid(tpr, fpr))
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def name(self):
+        return [self._name]

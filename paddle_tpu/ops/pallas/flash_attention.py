@@ -40,8 +40,12 @@ _LANES = 128  # VPU lane width: m/l scratch rows are padded to this
 
 
 def _block_sizes(sq: int, skv: int):
-    bq = min(256, sq)
-    bk = min(512, skv)
+    """Tile sizes for the Pallas grid; tunable via the
+    ``flash_attention_block_q``/``flash_attention_block_kv`` flags (parity:
+    the reference's FLAGS-tuned fused-attention tiling)."""
+    from ...flags import flag
+    bq = min(int(flag("flash_attention_block_q")), sq)
+    bk = min(int(flag("flash_attention_block_kv")), skv)
     return bq, bk
 
 

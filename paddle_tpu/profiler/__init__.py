@@ -65,10 +65,13 @@ def make_scheduler(*, closed: int, ready: int, record: int,
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
                           ) -> Callable:
-    """on_trace_ready callback writing traces under ``dir_name`` (parity:
-    paddle.profiler.export_chrome_tracing; format note in module doc)."""
+    """on_trace_ready callback directing trace output under ``dir_name``
+    (parity: paddle.profiler.export_chrome_tracing; format note in module
+    doc).  The Profiler reads ``handler.dir_name`` at construction, so the
+    XLA trace dump actually lands where the exporter points."""
     def handler(prof: "Profiler"):
         prof._last_export = dir_name
+    handler.dir_name = dir_name
     os.makedirs(dir_name, exist_ok=True)
     return handler
 
@@ -115,6 +118,9 @@ class Profiler:
                                        record=hi - lo, repeat=1)
         self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
         self.on_trace_ready = on_trace_ready
+        # an export_chrome_tracing handler declares where traces belong
+        if on_trace_ready is not None and hasattr(on_trace_ready, "dir_name"):
+            log_dir = on_trace_ready.dir_name
         self.log_dir = log_dir
         self.timer_only = timer_only
         self.step_num = 0

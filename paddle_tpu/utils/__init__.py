@@ -1,0 +1,5 @@
+"""paddle_tpu.utils — logging + small shared helpers."""
+
+from .logging import VLOG, get_logger, vlog_level
+
+__all__ = ["get_logger", "VLOG", "vlog_level"]

@@ -1,0 +1,47 @@
+"""VLOG-style logging.
+
+Parity: the reference's glog verbosity convention (``VLOG(n)`` in C++,
+gated by the ``GLOG_v`` env var; Python logger at paddle/utils — upstream
+layout).  ``VLOG(level, msg)`` emits only when ``level <= GLOG_v`` (or the
+``glog_v`` flag); the standard logger carries framework warnings.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_LOGGER: Optional[logging.Logger] = None
+
+
+def get_logger(name: str = "paddle_tpu", level: Optional[int] = None
+               ) -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None or _LOGGER.name != name:
+        logger = logging.getLogger(name)
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter(
+                "%(levelname).1s %(asctime)s %(name)s] %(message)s",
+                datefmt="%m%d %H:%M:%S"))
+            logger.addHandler(h)
+            logger.propagate = False
+        logger.setLevel(level if level is not None else logging.INFO)
+        _LOGGER = logger
+    return _LOGGER
+
+
+def vlog_level() -> int:
+    """Active verbosity: GLOG_v env var (reference convention), else 0."""
+    try:
+        return int(os.environ.get("GLOG_v", "0"))
+    except ValueError:
+        return 0
+
+
+def VLOG(level: int, msg: str, *args) -> None:
+    """Emit ``msg`` when ``level <= GLOG_v`` — the reference's VLOG(n)."""
+    if level <= vlog_level():
+        get_logger().info("[v%d] " + msg, level, *args)
