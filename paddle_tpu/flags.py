@@ -100,5 +100,5 @@ DEFINE("allocator_strategy", "xla",
        "parity flag: the reference exposes auto_growth; on TPU, XLA owns memory")
 DEFINE("pallas_interpret", False,
        "run Pallas kernels in interpreter mode (for CPU tests)")
-DEFINE("flash_attention_block_q", 512, "Pallas flash-attention q block size")
+DEFINE("flash_attention_block_q", 256, "Pallas flash-attention q block size")
 DEFINE("flash_attention_block_kv", 512, "Pallas flash-attention kv block size")

@@ -155,11 +155,11 @@ def test_export_chrome_tracing_directs_output(tmp_path):
 def test_flash_attention_block_flags_are_live():
     from paddle_tpu.ops.pallas.flash_attention import _block_sizes
 
-    assert _block_sizes(4096, 4096) == (512, 512)
+    assert _block_sizes(4096, 4096) == (256, 512)
     pt.set_flags({"flash_attention_block_q": 128,
                   "flash_attention_block_kv": 256})
     try:
         assert _block_sizes(4096, 4096) == (128, 256)
     finally:
-        pt.set_flags({"flash_attention_block_q": 512,
+        pt.set_flags({"flash_attention_block_q": 256,
                       "flash_attention_block_kv": 512})
