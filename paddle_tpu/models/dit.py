@@ -1,0 +1,265 @@
+"""DiT — diffusion transformer blocks (BASELINE.json config #3, the
+SD3/DiT workload).
+
+The reference side lives in PaddleMIX (ppdiffusers' DiT/SD3 blocks built on
+paddle.nn + fused attention); in-tree here as the 2D-attention benchmark
+workload.  Architecture per the DiT paper: patchify → N blocks of
+[AdaLN-Zero-modulated self-attention over patch tokens + MLP] conditioned
+on (timestep, class) embeddings → AdaLN final layer → unpatchify.
+
+TPU mapping: patch tokens are just a sequence — the same flash-attention
+kernel as the LLMs (full bidirectional, ``causal=False``); AdaLN modulation
+is elementwise and fuses into the surrounding matmuls; batch rides
+(dp, sharding), heads ride mp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.fleet.mp_layers import constrain
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.common import LayerNorm
+from ..nn.layer import Layer, LayerList
+from ..ops import flash_attention
+from ..tensor.math import matmul
+
+__all__ = ["DiTConfig", "DiT", "tiny_dit_config", "dit_xl_2_config"]
+
+
+@dataclasses.dataclass
+class DiTConfig:
+    input_size: int = 32          # latent H = W
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    depth: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    learn_sigma: bool = True
+    initializer_range: float = 0.02
+    dtype: str = "float32"
+    recompute: bool = False
+
+    @property
+    def out_channels(self) -> int:
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.input_size // self.patch_size) ** 2
+
+
+def dit_xl_2_config(**overrides) -> DiTConfig:
+    """DiT-XL/2 (the paper's flagship; SD3-class compute)."""
+    return dataclasses.replace(DiTConfig(), **overrides)
+
+
+def tiny_dit_config(**overrides) -> DiTConfig:
+    cfg = DiTConfig(input_size=8, patch_size=2, in_channels=4,
+                    hidden_size=64, depth=2, num_heads=4, num_classes=10)
+    return dataclasses.replace(cfg, **overrides)
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep features (fp32 — frequency precision matters)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class Mlp(Layer):
+    def __init__(self, width: int, hidden: int, out: Optional[int] = None,
+                 dtype=None, init_std: float = 0.02):
+        super().__init__()
+        init = I.Normal(std=init_std)
+        self.fc1 = self.create_parameter((width, hidden), dtype=dtype,
+                                         initializer=init,
+                                         sharding=P("sharding", "mp"),
+                                         attr_name="fc1")
+        self.b1 = self.create_parameter((hidden,), dtype=dtype,
+                                        initializer=I.Constant(0.0),
+                                        sharding=P("mp"), attr_name="b1")
+        self.fc2 = self.create_parameter((hidden, out or width), dtype=dtype,
+                                         initializer=init,
+                                         sharding=P("mp", "sharding"),
+                                         attr_name="fc2")
+        self.b2 = self.create_parameter((out or width,), dtype=dtype,
+                                        initializer=I.Constant(0.0),
+                                        attr_name="b2")
+
+    def forward(self, x):
+        return matmul(F.gelu(matmul(x, self.fc1) + self.b1,
+                             approximate=True), self.fc2) + self.b2
+
+
+def modulate(x, shift, scale):
+    return x * (1.0 + scale[:, None]) + shift[:, None]
+
+
+class DiTBlock(Layer):
+    """AdaLN-Zero block: modulation params regressed from the conditioning
+    vector, gates initialised to zero (identity block at init)."""
+
+    def __init__(self, c: DiTConfig):
+        super().__init__()
+        h = c.hidden_size
+        self.num_heads = c.num_heads
+        self.norm1 = LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                               bias_attr=False, dtype=c.dtype)
+        self.norm2 = LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                               bias_attr=False, dtype=c.dtype)
+        init = I.Normal(std=c.initializer_range)
+        self.qkv = self.create_parameter((h, 3 * h), dtype=c.dtype,
+                                         initializer=init,
+                                         sharding=P("sharding", "mp"),
+                                         attr_name="qkv")
+        self.proj = self.create_parameter((h, h), dtype=c.dtype,
+                                          initializer=init,
+                                          sharding=P("mp", "sharding"),
+                                          attr_name="proj")
+        self.mlp = Mlp(h, int(h * c.mlp_ratio), dtype=c.dtype,
+                       init_std=c.initializer_range)
+        # AdaLN-Zero: zero-init → every block starts as identity
+        self.ada = self.create_parameter((h, 6 * h), dtype=c.dtype,
+                                         initializer=I.Constant(0.0),
+                                         attr_name="ada")
+        self.ada_b = self.create_parameter((6 * h,), dtype=c.dtype,
+                                           initializer=I.Constant(0.0),
+                                           attr_name="ada_b")
+
+    def forward(self, x, cond):
+        b, n, h = x.shape
+        mods = matmul(F.silu(cond), self.ada) + self.ada_b
+        (shift_a, scale_a, gate_a,
+         shift_m, scale_m, gate_m) = jnp.split(mods, 6, axis=-1)
+
+        y = modulate(self.norm1(x), shift_a, scale_a)
+        qkv = matmul(y, self.qkv).reshape(b, n, 3, self.num_heads, -1)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = constrain(q, ("dp", "sharding"), None, "mp", None)
+        k = constrain(k, ("dp", "sharding"), None, "mp", None)
+        v = constrain(v, ("dp", "sharding"), None, "mp", None)
+        attn = flash_attention(q, k, v, causal=False).reshape(b, n, h)
+        x = x + gate_a[:, None] * matmul(attn, self.proj)
+        y = modulate(self.norm2(x), shift_m, scale_m)
+        return x + gate_m[:, None] * self.mlp(y)
+
+
+class FinalLayer(Layer):
+    def __init__(self, c: DiTConfig):
+        super().__init__()
+        h = c.hidden_size
+        out = c.patch_size * c.patch_size * c.out_channels
+        self.norm = LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                              bias_attr=False, dtype=c.dtype)
+        self.ada = self.create_parameter((h, 2 * h), dtype=c.dtype,
+                                         initializer=I.Constant(0.0),
+                                         attr_name="ada")
+        self.ada_b = self.create_parameter((2 * h,), dtype=c.dtype,
+                                           initializer=I.Constant(0.0),
+                                           attr_name="ada_b")
+        self.linear = self.create_parameter((h, out), dtype=c.dtype,
+                                            initializer=I.Constant(0.0),
+                                            attr_name="linear")
+
+    def forward(self, x, cond):
+        mods = matmul(F.silu(cond), self.ada) + self.ada_b
+        shift, scale = jnp.split(mods, 2, axis=-1)
+        return matmul(modulate(self.norm(x), shift, scale), self.linear)
+
+
+class DiT(Layer):
+    """forward(x, t, y) → predicted noise (+sigma); x: (B, C, H, W)."""
+
+    def __init__(self, config: DiTConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        h = c.hidden_size
+        p = c.patch_size
+        init = I.Normal(std=c.initializer_range)
+        self.patch_proj = self.create_parameter(
+            (p * p * c.in_channels, h), dtype=c.dtype, initializer=init,
+            sharding=P(None, "sharding"), attr_name="patch_proj")
+        self.patch_bias = self.create_parameter(
+            (h,), dtype=c.dtype, initializer=I.Constant(0.0),
+            attr_name="patch_bias")
+        # fixed 2D sin-cos positional embedding (the paper's choice)
+        self.register_buffer("pos_embed", _pos_embed_2d(
+            c.input_size // p, h))
+        self.t_mlp = Mlp(256, h, out=h, dtype=c.dtype,
+                         init_std=c.initializer_range)
+        self.y_embed = self.create_parameter(
+            (c.num_classes + 1, h), dtype=c.dtype, initializer=init,
+            attr_name="y_embed")  # +1 = the classifier-free null class
+        self.blocks = LayerList([DiTBlock(c) for _ in range(c.depth)])
+        self.final = FinalLayer(c)
+
+    # -- patch plumbing ------------------------------------------------------
+
+    def patchify(self, x):
+        c = self.config
+        b, ch, hh, ww = x.shape
+        p = c.patch_size
+        x = x.reshape(b, ch, hh // p, p, ww // p, p)
+        x = x.transpose(0, 2, 4, 3, 5, 1)       # (B, H/p, W/p, p, p, C)
+        return x.reshape(b, (hh // p) * (ww // p), p * p * ch)
+
+    def unpatchify(self, x):
+        c = self.config
+        b, n, _ = x.shape
+        p = c.patch_size
+        g = c.input_size // p
+        x = x.reshape(b, g, g, p, p, c.out_channels)
+        x = x.transpose(0, 5, 1, 3, 2, 4)
+        return x.reshape(b, c.out_channels, g * p, g * p)
+
+    def forward(self, x, t, y):
+        c = self.config
+        tokens = matmul(self.patchify(x), self.patch_proj) + self.patch_bias
+        tokens = tokens + self.pos_embed[None]
+        tokens = constrain(tokens, ("dp", "sharding"), None, None)
+        cond = self.t_mlp(timestep_embedding(t, 256).astype(tokens.dtype)) \
+            + jnp.take(self.y_embed, y, axis=0)
+        for blk in self.blocks:
+            if c.recompute and self.training:
+                tokens = jax.checkpoint(
+                    lambda h, cd, b=blk: b(h, cd))(tokens, cond)
+            else:
+                tokens = blk(tokens, cond)
+        return self.unpatchify(self.final(tokens, cond))
+
+    def compute_loss(self, x, t, y, target):
+        """Denoising objective: MSE over the noise channels (the DiT
+        training loss; sigma channels excluded like the paper's simple
+        loss)."""
+        pred = self.forward(x, t, y)
+        pred_noise = pred[:, :self.config.in_channels]
+        return jnp.mean((pred_noise.astype(jnp.float32)
+                         - target.astype(jnp.float32)) ** 2)
+
+
+def _pos_embed_2d(grid: int, dim: int):
+    """Fixed 2D sin-cos positional embedding (DiT/MAE recipe)."""
+    def _1d(pos, d):
+        omega = 1.0 / (10000.0 ** (jnp.arange(d // 2, dtype=jnp.float32)
+                                   / (d / 2.0)))
+        out = pos[:, None] * omega[None]
+        return jnp.concatenate([jnp.sin(out), jnp.cos(out)], axis=1)
+
+    coords = jnp.arange(grid, dtype=jnp.float32)
+    yy, xx = jnp.meshgrid(coords, coords, indexing="ij")
+    emb = jnp.concatenate([_1d(yy.ravel(), dim // 2),
+                           _1d(xx.ravel(), dim // 2)], axis=1)
+    return emb

@@ -1,0 +1,180 @@
+"""Mamba-2 (SSD) causal LM (BASELINE.json config #5).
+
+The reference implements selective-scan as CUDA kernels (upstream:
+paddle/phi/kernels/fusion/gpu selective_scan family, vendored model code in
+PaddleNLP); here the mixer is built on :func:`paddle_tpu.ops.ssd.ssd_scan`,
+the chunked MXU formulation (see ops/ssd.py for why no Pallas kernel is
+needed).
+
+Mamba-2 mixer (the SSD paper's architecture):
+  in_proj → [z | xBC | dt];  causal depthwise conv over xBC;  split into
+  x (heads×head_dim), B, C (groups×state);  a_t = exp(-softplus(dt)·A_h);
+  y = SSD(x·dt, a, B, C) + D⊙x;  out = out_proj(y · silu(z)).
+
+TPU mapping: the head dim rides mp, batch rides (dp, sharding); the
+depthwise conv is a tiny sliding window XLA handles as a fused gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.fleet.mp_layers import constrain
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.common import RMSNorm
+from ..nn.layer import Layer, LayerList
+from ..ops.ssd import ssd_scan
+from ..tensor.math import matmul
+from .llama import _batch_spec, causal_lm_loss
+
+__all__ = ["Mamba2Config", "Mamba2Mixer", "Mamba2ForCausalLM",
+           "tiny_mamba2_config"]
+
+
+@dataclasses.dataclass
+class Mamba2Config:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    state_size: int = 64          # N
+    num_heads: int = 24           # H
+    head_dim: int = 64            # P; d_inner = H * P = expand * hidden
+    num_groups: int = 1           # G (B/C groups, GQA-style)
+    conv_kernel: int = 4
+    num_hidden_layers: int = 4
+    chunk_size: int = 64
+    rms_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dt_min: float = 0.001
+    dt_max: float = 0.1
+    dtype: str = "float32"
+    recompute: bool = False
+
+    @property
+    def d_inner(self) -> int:
+        return self.num_heads * self.head_dim
+
+
+def tiny_mamba2_config(**overrides) -> Mamba2Config:
+    cfg = Mamba2Config(vocab_size=256, hidden_size=64, state_size=16,
+                       num_heads=4, head_dim=32, num_groups=2,
+                       num_hidden_layers=2, chunk_size=8)
+    return dataclasses.replace(cfg, **overrides)
+
+
+class Mamba2Mixer(Layer):
+    def __init__(self, c: Mamba2Config):
+        super().__init__()
+        self.config = c
+        d_in = c.d_inner
+        g_n = c.num_groups * c.state_size
+        conv_dim = d_in + 2 * g_n
+        init = I.Normal(std=c.initializer_range)
+        self.in_proj = self.create_parameter(
+            (c.hidden_size, 2 * d_in + 2 * g_n + c.num_heads),
+            dtype=c.dtype, initializer=init, sharding=P("sharding", "mp"),
+            attr_name="in_proj")
+        # depthwise causal conv weights: (K, conv_dim)
+        self.conv_w = self.create_parameter(
+            (c.conv_kernel, conv_dim), dtype=c.dtype, initializer=init,
+            attr_name="conv_w")
+        self.conv_b = self.create_parameter(
+            (conv_dim,), dtype=c.dtype, initializer=I.Constant(0.0),
+            attr_name="conv_b")
+        # per-head decay rate A (stored as log) + dt bias + skip D
+        self.A_log = self.create_parameter(
+            (c.num_heads,), dtype="float32",
+            initializer=I.Uniform(low=0.0, high=1.3), attr_name="A_log")
+        self.dt_bias = self.create_parameter(
+            (c.num_heads,), dtype="float32", initializer=I.Constant(0.0),
+            attr_name="dt_bias")
+        self.D = self.create_parameter(
+            (c.num_heads,), dtype="float32", initializer=I.Constant(1.0),
+            attr_name="D")
+        self.norm = RMSNorm(d_in, epsilon=c.rms_norm_eps, dtype=c.dtype)
+        self.out_proj = self.create_parameter(
+            (d_in, c.hidden_size), dtype=c.dtype, initializer=init,
+            sharding=P("mp", "sharding"), attr_name="out_proj")
+
+    def _causal_dw_conv(self, u):
+        """(B, L, D) depthwise causal conv, kernel K (the Mamba conv1d)."""
+        k = self.config.conv_kernel
+        pad = jnp.pad(u, ((0, 0), (k - 1, 0), (0, 0)))
+        out = jnp.zeros_like(u)
+        for i in range(k):  # K is tiny (4): unrolled taps fuse into one op
+            out = out + pad[:, i:i + u.shape[1]] * self.conv_w[i]
+        return out + self.conv_b
+
+    def forward(self, x):
+        c = self.config
+        bsz, L, _ = x.shape
+        d_in, g_n, H = c.d_inner, c.num_groups * c.state_size, c.num_heads
+        proj = matmul(x, self.in_proj)
+        z, xbc, dt = jnp.split(proj, [d_in, 2 * d_in + 2 * g_n], axis=-1)
+        xbc = F.silu(self._causal_dw_conv(xbc))
+        xs, b, cc = jnp.split(xbc, [d_in, d_in + g_n], axis=-1)
+
+        dt = jax.nn.softplus(dt.astype(jnp.float32)
+                             + self.dt_bias)              # (B, L, H)
+        dt = jnp.clip(dt, c.dt_min, c.dt_max * 100.0)
+        a = jnp.exp(-dt * jnp.exp(self.A_log))            # (B, L, H) decay
+        xh = xs.reshape(bsz, L, H, c.head_dim)
+        xh = constrain(xh, ("dp", "sharding"), None, "mp", None)
+        x_in = (xh.astype(jnp.float32) * dt[..., None])
+        bg = b.reshape(bsz, L, c.num_groups, c.state_size).astype(jnp.float32)
+        cg = cc.reshape(bsz, L, c.num_groups,
+                        c.state_size).astype(jnp.float32)
+        y, _ = ssd_scan(x_in, a, bg, cg, chunk=min(c.chunk_size, L))
+        y = y + self.D[None, None, :, None] * xh.astype(jnp.float32)
+        y = y.reshape(bsz, L, d_in).astype(x.dtype)
+        y = self.norm(y * F.silu(z))
+        return matmul(y, self.out_proj)
+
+
+class Mamba2Block(Layer):
+    def __init__(self, c: Mamba2Config):
+        super().__init__()
+        self.norm = RMSNorm(c.hidden_size, epsilon=c.rms_norm_eps,
+                            dtype=c.dtype)
+        self.mixer = Mamba2Mixer(c)
+
+    def forward(self, x):
+        return x + self.mixer(self.norm(x))
+
+
+class Mamba2ForCausalLM(Layer):
+    def __init__(self, config: Mamba2Config):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embed_tokens = self.create_parameter(
+            (c.vocab_size, c.hidden_size), dtype=c.dtype,
+            initializer=I.Normal(std=c.initializer_range),
+            sharding=P("mp", "sharding"), attr_name="embed_tokens")
+        self.layers = LayerList([Mamba2Block(c)
+                                 for _ in range(c.num_hidden_layers)])
+        self.norm_f = RMSNorm(c.hidden_size, epsilon=c.rms_norm_eps,
+                              dtype=c.dtype)
+        self.lm_head = self.create_parameter(
+            (c.hidden_size, c.vocab_size), dtype=c.dtype,
+            initializer=I.Normal(std=c.initializer_range),
+            sharding=P("sharding", "mp"), attr_name="lm_head")
+
+    def forward(self, input_ids):
+        c = self.config
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = constrain(x, *_batch_spec(x.ndim))
+        for blk in self.layers:
+            if c.recompute and self.training:
+                x = jax.checkpoint(lambda h, b=blk: b(h))(x)
+            else:
+                x = blk(x)
+        return matmul(self.norm_f(x), self.lm_head)
+
+    def compute_loss(self, input_ids, labels):
+        return causal_lm_loss(self.forward(input_ids), labels)

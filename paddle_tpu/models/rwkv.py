@@ -1,0 +1,170 @@
+"""RWKV-4 causal LM (BASELINE.json config #5, the RNN-family workload).
+
+Reference side: PaddleNLP's RWKV with the wkv custom CUDA op; here the mix
+is :func:`paddle_tpu.ops.rwkv.wkv` (stabilised lax.scan).  Standard RWKV-4
+block: pre-LN [time-mix (R/K/V token-shift interpolation → wkv → gated
+output) + channel-mix (squared-ReLU FFN with token-shift)].
+
+TPU mapping: all projections are (dp, sharding)-batched matmuls with the
+channel dim on mp; the wkv scan itself is sequential in L by construction
+(the linear-RNN family's defining trade) and carries only a (B, C) state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.fleet.mp_layers import constrain
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.common import LayerNorm
+from ..nn.layer import Layer, LayerList
+from ..ops.rwkv import wkv
+from ..tensor.math import matmul
+from .llama import _batch_spec, causal_lm_loss
+
+__all__ = ["RwkvConfig", "RwkvForCausalLM", "tiny_rwkv_config"]
+
+
+@dataclasses.dataclass
+class RwkvConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_hidden_layers: int = 4
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: str = "float32"
+    recompute: bool = False
+
+
+def tiny_rwkv_config(**overrides) -> RwkvConfig:
+    cfg = RwkvConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2)
+    return dataclasses.replace(cfg, **overrides)
+
+
+def _token_shift(x):
+    """x_{t-1} (zeros at t=0) — RWKV's 1-step temporal mix partner."""
+    return jnp.pad(x, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+
+
+class RwkvTimeMix(Layer):
+    def __init__(self, c: RwkvConfig, layer_idx: int):
+        super().__init__()
+        h = c.hidden_size
+        init = I.Normal(std=c.initializer_range)
+        ratio = layer_idx / max(1, c.num_hidden_layers - 1)
+        # decay/bonus init follows the RWKV recipe: spread across channels
+        self.time_decay = self.create_parameter(
+            (h,), dtype="float32",
+            initializer=I.Uniform(low=0.3, high=2.0 + 2.0 * ratio),
+            attr_name="time_decay")
+        self.time_first = self.create_parameter(
+            (h,), dtype="float32", initializer=I.Normal(std=0.3),
+            attr_name="time_first")
+        for name in ("mix_k", "mix_v", "mix_r"):
+            setattr(self, name, self.create_parameter(
+                (h,), dtype=c.dtype, initializer=I.Constant(0.5),
+                attr_name=name))
+        for name in ("key", "value", "receptance"):
+            setattr(self, name, self.create_parameter(
+                (h, h), dtype=c.dtype, initializer=init,
+                sharding=P("sharding", "mp"), attr_name=name))
+        self.output = self.create_parameter(
+            (h, h), dtype=c.dtype, initializer=init,
+            sharding=P("mp", "sharding"), attr_name="output")
+
+    def forward(self, x):
+        xx = _token_shift(x)
+        xk = x * self.mix_k + xx * (1 - self.mix_k)
+        xv = x * self.mix_v + xx * (1 - self.mix_v)
+        xr = x * self.mix_r + xx * (1 - self.mix_r)
+        r = F.sigmoid(matmul(xr, self.receptance))
+        k = matmul(xk, self.key)
+        v = matmul(xv, self.value)
+        mixed = wkv(self.time_decay, self.time_first, k, v).astype(x.dtype)
+        return matmul(r * mixed, self.output)
+
+
+class RwkvChannelMix(Layer):
+    def __init__(self, c: RwkvConfig):
+        super().__init__()
+        h = c.hidden_size
+        init = I.Normal(std=c.initializer_range)
+        for name in ("mix_k", "mix_r"):
+            setattr(self, name, self.create_parameter(
+                (h,), dtype=c.dtype, initializer=I.Constant(0.5),
+                attr_name=name))
+        self.key = self.create_parameter((h, 4 * h), dtype=c.dtype,
+                                         initializer=init,
+                                         sharding=P("sharding", "mp"),
+                                         attr_name="key")
+        self.value = self.create_parameter((4 * h, h), dtype=c.dtype,
+                                           initializer=init,
+                                           sharding=P("mp", "sharding"),
+                                           attr_name="value")
+        self.receptance = self.create_parameter((h, h), dtype=c.dtype,
+                                                initializer=init,
+                                                sharding=P("sharding", "mp"),
+                                                attr_name="receptance")
+
+    def forward(self, x):
+        xx = _token_shift(x)
+        xk = x * self.mix_k + xx * (1 - self.mix_k)
+        xr = x * self.mix_r + xx * (1 - self.mix_r)
+        k = jnp.square(F.relu(matmul(xk, self.key)))
+        return F.sigmoid(matmul(xr, self.receptance)) * matmul(k, self.value)
+
+
+class RwkvBlock(Layer):
+    def __init__(self, c: RwkvConfig, layer_idx: int):
+        super().__init__()
+        self.ln1 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps,
+                             dtype=c.dtype)
+        self.ln2 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps,
+                             dtype=c.dtype)
+        self.attention = RwkvTimeMix(c, layer_idx)
+        self.feed_forward = RwkvChannelMix(c)
+
+    def forward(self, x):
+        x = x + self.attention(self.ln1(x))
+        return x + self.feed_forward(self.ln2(x))
+
+
+class RwkvForCausalLM(Layer):
+    def __init__(self, config: RwkvConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embeddings = self.create_parameter(
+            (c.vocab_size, c.hidden_size), dtype=c.dtype,
+            initializer=I.Normal(std=c.initializer_range),
+            sharding=P("mp", "sharding"), attr_name="embeddings")
+        self.ln_pre = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps,
+                                dtype=c.dtype)
+        self.blocks = LayerList([RwkvBlock(c, i)
+                                 for i in range(c.num_hidden_layers)])
+        self.ln_out = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps,
+                                dtype=c.dtype)
+        self.head = self.create_parameter(
+            (c.hidden_size, c.vocab_size), dtype=c.dtype,
+            initializer=I.Normal(std=c.initializer_range),
+            sharding=P("sharding", "mp"), attr_name="head")
+
+    def forward(self, input_ids):
+        c = self.config
+        x = jnp.take(self.embeddings, input_ids, axis=0)
+        x = constrain(x, *_batch_spec(x.ndim))
+        x = self.ln_pre(x)
+        for blk in self.blocks:
+            if c.recompute and self.training:
+                x = jax.checkpoint(lambda h, b=blk: b(h))(x)
+            else:
+                x = blk(x)
+        return matmul(self.ln_out(x), self.head)
+
+    def compute_loss(self, input_ids, labels):
+        return causal_lm_loss(self.forward(input_ids), labels)

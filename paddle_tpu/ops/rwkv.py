@@ -1,0 +1,83 @@
+"""RWKV wkv op — the linear-attention recurrence (BASELINE.json config #5).
+
+Equivalent of the reference's wkv CUDA kernel (RWKV-4 family; vendored on
+the PaddleNLP side, with the cuda kernel shipped as a custom op).  The
+recurrence per channel c:
+
+    wkv_t = (Σ_{i<t} e^{-(t-1-i)w + k_i} v_i + e^{u + k_t} v_t)
+          / (Σ_{i<t} e^{-(t-1-i)w + k_i}     + e^{u + k_t})
+
+computed with the running-max-exponent stabilisation of the official
+kernel: state (p, q, o) where p/q are the exp-weighted numerator/
+denominator relative to the running max o — no overflow for any k.
+
+A ``lax.scan`` carries the (B, C)-shaped state over L; each step is pure
+VPU elementwise work, fused by XLA into a few ops — the op is
+bandwidth-light (state is tiny), so a sequential scan is the right TPU
+shape; there is no matmul to win back on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["wkv", "wkv_reference"]
+
+
+def wkv(w, u, k, v):
+    """RWKV linear-attention mix.
+
+    Args:
+      w: (C,) channel decay rates, >= 0 (applied as e^{-w} per step).
+      u: (C,) first-token bonus.
+      k, v: (B, L, C) keys / values.
+    Returns: (B, L, C) mixed values, fp32.
+    """
+    w = -jnp.asarray(w, jnp.float32)       # per-step log-decay (<= 0)
+    u = jnp.asarray(u, jnp.float32)
+    k = jnp.asarray(k, jnp.float32)
+    v = jnp.asarray(v, jnp.float32)
+    B, L, C = k.shape
+
+    def step(state, kv_t):
+        p, q, o = state                     # (B, C) each
+        k_t, v_t = kv_t
+        # output at t: include the bonus term e^{u + k_t} v_t
+        no = jnp.maximum(o, u + k_t)
+        a = jnp.exp(o - no)
+        b = jnp.exp(u + k_t - no)
+        out = (a * p + b * v_t) / (a * q + b)
+        # state update: decay the history by e^{w}, absorb token t
+        no2 = jnp.maximum(o + w, k_t)
+        a2 = jnp.exp(o + w - no2)
+        b2 = jnp.exp(k_t - no2)
+        return (a2 * p + b2 * v_t, a2 * q + b2, no2), out
+
+    init = (jnp.zeros((B, C), jnp.float32), jnp.zeros((B, C), jnp.float32),
+            jnp.full((B, C), -1e38, jnp.float32))
+    _, out = lax.scan(step, init, (jnp.moveaxis(k, 1, 0),
+                                   jnp.moveaxis(v, 1, 0)))
+    return jnp.moveaxis(out, 0, 1)
+
+
+def wkv_reference(w, u, k, v):
+    """NumPy float64 oracle — the direct double sum, no stabilisation."""
+    w = np.asarray(w, np.float64)
+    u = np.asarray(u, np.float64)
+    k = np.asarray(k, np.float64)
+    v = np.asarray(v, np.float64)
+    B, L, C = k.shape
+    out = np.zeros((B, L, C))
+    for b in range(B):
+        for t in range(L):
+            num = np.exp(u + k[b, t]) * v[b, t]
+            den = np.exp(u + k[b, t])
+            for i in range(t):
+                wgt = np.exp(-(t - 1 - i) * w + k[b, i])
+                num += wgt * v[b, i]
+                den += wgt
+            out[b, t] = num / den
+    return out
