@@ -162,6 +162,10 @@ class PipelineLayer(Layer):
         # physical stage holds V non-contiguous model chunks.
         n_chunks = num_stages * num_virtual_pipeline_stages
         self.descs = list(layer_descs)
+        if partition is not None and len(partition) != n_chunks:
+            raise ValueError(
+                f"partition has {len(partition)} entries but needs one per "
+                f"chunk: num_stages*num_virtual_pipeline_stages = {n_chunks}")
         if partition is None:
             n = len(self.descs)
             base, extra = divmod(n, n_chunks)

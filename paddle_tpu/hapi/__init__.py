@@ -121,7 +121,10 @@ class Model:
             if self.loss is not None:
                 losses.append(float(self.loss(out, jnp.asarray(y))))
             for m in self.metrics:
-                m.update(m.compute(out, y))
+                res = m.compute(out, y)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                m.update(*res)
         logs = {}
         if losses:
             logs["eval_loss" if _inside_fit else "loss"] = float(

@@ -54,8 +54,11 @@ class Accuracy(Metric):
         label = np.asarray(label)
         maxk = max(self.topk)
         order = np.argsort(-pred, axis=-1)[..., :maxk]
-        if label.ndim == pred.ndim:  # one-hot / soft labels
-            label = np.argmax(label, axis=-1)
+        if label.ndim == pred.ndim:
+            if label.shape[-1] != 1:  # one-hot / soft labels
+                label = np.argmax(label, axis=-1)
+            else:  # (N, 1) column of integer class indices
+                label = label[..., 0]
         correct = order == label[..., None]
         return correct
 
@@ -161,7 +164,8 @@ class Auc(Metric):
             return 0.0
         tpr = tp / P
         fpr = fp / N
-        return float(np.trapezoid(tpr, fpr))
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz
+        return float(trapezoid(tpr, fpr))
 
     def reset(self):
         self._pos = np.zeros(self.num_thresholds + 1, np.int64)

@@ -238,7 +238,6 @@ class Qwen2VLForConditionalGeneration(Layer):
         x = jnp.take(self.embed_tokens, input_ids, axis=0)
         x = constrain(x, *_batch_spec(x.ndim))
         rope = (self.rope_cos, self.rope_sin)
-        cross_iter = iter(self.cross)
         for i, blk in enumerate(self.layers):
             def run(h, vis, blk=blk, i=i):
                 h = blk(h, rope, position_ids)

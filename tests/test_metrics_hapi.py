@@ -36,6 +36,18 @@ def test_accuracy_topk():
     assert m.name() == ["acc_top1", "acc_top2"]
 
 
+def test_accuracy_column_label_is_indices_not_onehot():
+    # (N, 1) integer class-index labels (paddle's canonical label shape)
+    # must NOT be argmaxed to all-zeros.
+    m = metric.Accuracy()
+    pred = np.array([[0.1, 0.7, 0.2],
+                     [0.8, 0.1, 0.1],
+                     [0.3, 0.3, 0.4]])
+    label = np.array([[1], [2], [2]])
+    m.update(m.compute(pred, label))
+    assert m.accumulate() == pytest.approx(2 / 3)
+
+
 def test_precision_recall():
     p, r = metric.Precision(), metric.Recall()
     preds = np.array([0.9, 0.8, 0.2, 0.7])   # predicted pos: 0,1,3
@@ -116,6 +128,20 @@ def test_model_evaluate_with_metric():
     assert "acc" in logs and 0.0 <= logs["acc"] <= 1.0
     preds = model.predict([data[0][0]])
     assert preds[0].shape == (8, 3)
+
+
+def test_model_evaluate_unpacks_tuple_compute():
+    # Metrics whose compute() returns the base (pred, label) tuple
+    # (Precision/Recall/Auc) need update(*res), not update(res).
+    pt.seed(3)
+    net = nn.Linear(4, 1)
+    model = hapi.Model(net)
+    model.prepare(metrics=[metric.Precision(), metric.Recall()])
+    data = [(rng.standard_normal((8, 4)).astype(np.float32),
+             rng.randint(0, 2, (8, 1)))]
+    logs = model.evaluate(data)
+    assert 0.0 <= logs["precision"] <= 1.0
+    assert 0.0 <= logs["recall"] <= 1.0
 
 
 # -- logging -----------------------------------------------------------------
