@@ -38,7 +38,7 @@ from ..topology import canonical_axis
 
 __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
-    "ParallelCrossEntropy", "constrain",
+    "ParallelCrossEntropy", "constrain", "vocab_parallel_lookup",
 ]
 
 
@@ -50,8 +50,12 @@ def constrain(x, *spec_entries):
     mesh = env.active_mesh()
     if mesh is None:
         return x
-    names = set(mesh.axis_names)
+    spec = P(*_filter_spec(spec_entries, set(mesh.axis_names)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
+
+def _filter_spec(spec_entries, names):
+    """Drop mesh axes not in ``names`` from a PartitionSpec's entries."""
     def keep(entry):
         if entry is None:
             return None
@@ -60,8 +64,123 @@ def constrain(x, *spec_entries):
             return kept if kept else None
         return entry if entry in names else None
 
-    spec = P(*(keep(e) for e in spec_entries))
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return tuple(keep(e) for e in spec_entries)
+
+
+def _axes_tuple(entry):
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def vocab_parallel_lookup(table, ids,
+                          table_spec=P("mp", "sharding"),
+                          ids_spec=P(("dp", "sharding"), "sep")):
+    """Embedding lookup with the vocab dim sharded — the reference's
+    VocabParallelEmbedding dataflow (mask out-of-shard ids, local gather,
+    allreduce), written as an explicit ``shard_map`` so the SPMD partitioner
+    never falls back to "involuntary full rematerialization" of the table
+    (the gather-on-sharded-dim cliff recorded in MULTICHIP_r02).
+
+    ``table`` is (vocab, hidden) with spec ``table_spec``; ``ids`` is any
+    integer-shaped batch with spec ``ids_spec``.  The result has the ids'
+    batch layout with hidden replicated (the layout every decoder block
+    expects at entry).  Collectives: psum over the vocab axes of an
+    activation-sized partial + all-gather of the hidden shards — never a
+    table-sized transfer.
+
+    Out-of-range ids (negative or ≥ vocab) produce a zero row on every
+    path — the reference's masked-lookup semantics — so single-device and
+    multi-chip runs of the same checkpoint agree bit-for-bit.
+
+    Falls back to a masked ``jnp.take`` when no mesh is active or shapes
+    don't divide the mesh axes (single-device tests, odd tiny configs);
+    the mesh-active fallback logs a one-shot VLOG(1) warning, because it
+    reintroduces the table-replication cost the shard_map path avoids.
+    """
+    def masked_take(reason=None):
+        if reason is not None:
+            _warn_fallback_once(reason)
+        ok = (ids >= 0) & (ids < table.shape[0])
+        out = jnp.take(table, jnp.where(ok, ids, 0), axis=0)
+        return jnp.where(ok[..., None], out, jnp.zeros((), table.dtype))
+
+    mesh = env.active_mesh()
+    if mesh is None:
+        return masked_take()
+    names = set(mesh.axis_names)
+    t_spec = _filter_spec(tuple(table_spec) + (None,) * 2, names)[:2]
+    vocab_axes = tuple(a for a in _axes_tuple(t_spec[0])
+                       if mesh.shape[a] > 1)
+    hidden_axes = tuple(a for a in _axes_tuple(t_spec[1])
+                        if mesh.shape[a] > 1)
+    # ids must not be sharded on any axis the table uses: a device holding
+    # batch block j of such an axis would also hold only hidden block j,
+    # so no device could produce the (batch j, other hidden blocks) tiles.
+    # Replicating ids over those axes is free to fix up afterwards — the
+    # caller's batch-spec constraint turns replication into a local slice.
+    table_axes = set(vocab_axes) | set(hidden_axes)
+    i_spec = tuple(
+        e for e in (tuple(a for a in _axes_tuple(entry)
+                          if a in names and a not in table_axes) or None
+                    for entry in tuple(ids_spec) + (None,) * ids.ndim)
+    )[:ids.ndim]
+    i_spec = tuple(e[0] if isinstance(e, tuple) and len(e) == 1 else e
+                   for e in i_spec)
+
+    def size(axes):
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        return n
+
+    # shard_map needs every sharded dim divisible by its axes' product
+    if (table.shape[0] % size(vocab_axes) or
+            table.shape[1] % size(hidden_axes)):
+        return masked_take(
+            f"table {table.shape} not divisible by mesh axes "
+            f"{vocab_axes + hidden_axes}")
+    for d, e in enumerate(i_spec):
+        if ids.shape[d] % size(tuple(a for a in _axes_tuple(e)
+                                     if mesh.shape[a] > 1)):
+            return masked_take(
+                f"ids dim {d} ({ids.shape[d]}) not divisible by {e}")
+
+    def body(tab, idx):
+        if vocab_axes:
+            shard = jnp.zeros((), jnp.int32)
+            for a in vocab_axes:
+                shard = shard * mesh.shape[a] + jax.lax.axis_index(a)
+            lo = shard * tab.shape[0]
+            loc = idx - lo
+            ok = (loc >= 0) & (loc < tab.shape[0]) & (idx >= 0)
+        else:
+            loc = idx
+            ok = (idx >= 0) & (idx < tab.shape[0])
+        out = jnp.take(tab, jnp.where(ok, loc, 0), axis=0)
+        out = jnp.where(ok[..., None], out, jnp.zeros((), out.dtype))
+        if vocab_axes:
+            out = jax.lax.psum(out, vocab_axes)
+        for a in reversed(hidden_axes):
+            out = jax.lax.all_gather(out, a, axis=out.ndim - 1, tiled=True)
+        return out
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(*t_spec), P(*i_spec)),
+        out_specs=P(*(i_spec + (None,))), check_vma=False)(table, ids)
+
+
+_fallback_warned = set()
+
+
+def _warn_fallback_once(reason: str):
+    if reason in _fallback_warned:
+        return
+    _fallback_warned.add(reason)
+    from ...utils.logging import VLOG
+    VLOG(1, f"vocab_parallel_lookup: mesh active but falling back to a "
+            f"plain (table-replicating) gather — {reason}")
 
 
 class ColumnParallelLinear(Layer):
@@ -139,7 +258,9 @@ class VocabParallelEmbedding(Layer):
     """Embedding with the vocab dim sharded on the mp axis.
 
     The reference masks out-of-shard ids, looks up locally and all-reduces;
-    XLA lowers the sharded gather to the same pattern.
+    :func:`vocab_parallel_lookup` implements exactly that dataflow in a
+    ``shard_map`` (left to itself, the SPMD partitioner replicates the
+    table for a gather on the sharded dim — the MULTICHIP_r02 perf cliff).
     """
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
@@ -154,7 +275,10 @@ class VocabParallelEmbedding(Layer):
             sharding=P(self.mp_axis, None), attr_name="weight")
 
     def forward(self, ids):
-        return F.embedding(ids, self.weight)
+        # default ids_spec: batch stays (dp, sharding)-sharded through the
+        # lookup rather than replicating the global batch on every device
+        return vocab_parallel_lookup(ids=ids, table=self.weight,
+                                     table_spec=P(self.mp_axis, None))
 
 
 class ParallelCrossEntropy(Layer):

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..distributed.fleet.mp_layers import constrain
+from ..distributed.fleet.mp_layers import constrain, vocab_parallel_lookup
 from ..distributed.moe import GShardGate, MoELayer
 from ..nn import initializer as I
 from ..nn.layer import Layer, LayerList
@@ -153,7 +153,7 @@ class ErnieMoEModel(Layer):
     def forward(self, input_ids, position_ids=None
                 ) -> Tuple[jax.Array, jax.Array]:
         c = self.config
-        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         x = constrain(x, *_batch_spec(x.ndim))
         rope = (self.rope_cos, self.rope_sin)
         aux_total = jnp.zeros((), jnp.float32)

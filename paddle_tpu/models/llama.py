@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..distributed.fleet.mp_layers import constrain
+from ..distributed.fleet.mp_layers import constrain, vocab_parallel_lookup
 from ..nn import functional as F
 from ..tensor.math import matmul
 from ..nn import initializer as I
@@ -239,7 +239,7 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, position_ids=None):
         c = self.config
-        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         x = constrain(x, *_batch_spec(x.ndim))
         rope = (self.rope_cos, self.rope_sin)
         for block in self.layers:
@@ -309,7 +309,7 @@ class LlamaEmbeddingPipe(Layer):
             sharding=P("mp", "sharding"), attr_name="embed_tokens")
 
     def forward(self, input_ids):
-        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         return constrain(x, *_batch_spec(x.ndim))
 
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..distributed.fleet.mp_layers import constrain
+from ..distributed.fleet.mp_layers import constrain, vocab_parallel_lookup
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.common import RMSNorm
@@ -167,7 +167,7 @@ class Mamba2ForCausalLM(Layer):
 
     def forward(self, input_ids):
         c = self.config
-        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         x = constrain(x, *_batch_spec(x.ndim))
         for blk in self.layers:
             if c.recompute and self.training:

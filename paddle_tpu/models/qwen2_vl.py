@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..distributed.fleet.mp_layers import constrain
+from ..distributed.fleet.mp_layers import constrain, vocab_parallel_lookup
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.common import LayerNorm, RMSNorm
@@ -235,7 +235,7 @@ class Qwen2VLForConditionalGeneration(Layer):
     def forward(self, input_ids, pixel_values, position_ids=None):
         c = self.config
         vision = self.visual(pixel_values)
-        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         x = constrain(x, *_batch_spec(x.ndim))
         rope = (self.rope_cos, self.rope_sin)
         for i, blk in enumerate(self.layers):

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..distributed.fleet.mp_layers import constrain
+from ..distributed.fleet.mp_layers import constrain, vocab_parallel_lookup
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.common import LayerNorm
@@ -156,7 +156,7 @@ class RwkvForCausalLM(Layer):
 
     def forward(self, input_ids):
         c = self.config
-        x = jnp.take(self.embeddings, input_ids, axis=0)
+        x = vocab_parallel_lookup(self.embeddings, input_ids)
         x = constrain(x, *_batch_spec(x.ndim))
         x = self.ln_pre(x)
         for blk in self.blocks:

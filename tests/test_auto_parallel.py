@@ -131,6 +131,70 @@ def test_vocab_parallel_embedding_matches_serial(fleet_mp4):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
 
 
+def test_vocab_parallel_lookup_fwd_bwd_matches_take(fleet_mp4):
+    """The shard_map masked-gather+psum path must equal a plain take,
+    forward and backward, on a hybrid (dp×mp) mesh."""
+    from paddle_tpu.distributed.fleet.mp_layers import vocab_parallel_lookup
+    rng = np.random.RandomState(5)
+    table = jnp.asarray(rng.randn(64, 16), jnp.float32)
+    ids = jnp.asarray(rng.randint(0, 64, (4, 8)))
+    cot = jnp.asarray(rng.randn(4, 8, 16), jnp.float32)
+
+    def para(t):
+        return jnp.vdot(cot, vocab_parallel_lookup(
+            t, ids, table_spec=P("mp", None)))
+
+    def serial(t):
+        return jnp.vdot(cot, jnp.take(t, ids, axis=0))
+
+    out, grad = jax.jit(jax.value_and_grad(para))(table)
+    ref_out, ref_grad = jax.value_and_grad(serial)(table)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad),
+                               rtol=1e-5, atol=1e-6)
+
+    # hidden-sharded table (the flagship llama layout) — same contract,
+    # including the backward through the tiled all_gather transpose
+    def para2(t):
+        return jnp.vdot(cot, vocab_parallel_lookup(
+            t, ids, table_spec=P("mp", "dp")))
+
+    out2, grad2 = jax.jit(jax.value_and_grad(para2))(table)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref_out),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad2), np.asarray(ref_grad),
+                               rtol=1e-5, atol=1e-6)
+
+    # one-entry spec = hidden implied-replicated (PartitionSpec convention)
+    out3 = jax.jit(lambda t: vocab_parallel_lookup(
+        t, ids, table_spec=P("mp")))(table)
+    np.testing.assert_allclose(np.asarray(out3),
+                               np.asarray(table)[np.asarray(ids)], rtol=1e-6)
+
+
+def test_vocab_parallel_lookup_oob_ids_zero_on_all_paths(fleet_mp4):
+    """Invalid ids (negative / ≥ vocab) → zero rows, identically on the
+    shard_map path, the divisibility fallback, and the no-mesh path."""
+    from paddle_tpu.distributed.fleet.mp_layers import vocab_parallel_lookup
+    table = jnp.asarray(np.random.RandomState(0).randn(64, 16), jnp.float32)
+    ids = jnp.asarray([[0, -1, 63, 64], [100, 5, -7, 1]])
+
+    sharded = jax.jit(lambda t: vocab_parallel_lookup(
+        t, ids, table_spec=P("mp", None)))(table)
+    # vocab 63 not divisible by mp=4 → masked-take fallback under the mesh
+    fallback = jax.jit(lambda t: vocab_parallel_lookup(
+        t[:63], ids, table_spec=P("mp", None)))(table)
+
+    ref = np.asarray(table)[np.clip(np.asarray(ids), 0, 63)]
+    bad = (np.asarray(ids) < 0) | (np.asarray(ids) > 63)
+    ref[bad] = 0.0
+    np.testing.assert_allclose(np.asarray(sharded), ref, rtol=1e-6)
+    ref63 = ref.copy()
+    ref63[np.asarray(ids) == 63] = 0.0
+    np.testing.assert_allclose(np.asarray(fallback), ref63, rtol=1e-6)
+
+
 def test_parallel_cross_entropy_matches_serial(fleet_mp4):
     pce = fleet.ParallelCrossEntropy()
     rng = np.random.RandomState(3)
