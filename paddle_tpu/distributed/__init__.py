@@ -13,9 +13,10 @@ from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate,
                             placements_to_spec, reshard, shard_layer,
                             shard_tensor, spec_to_placements)
 from .collective import (AxisGroup, ReduceOp, all_gather, all_reduce,
-                         all_to_all, axis_index, barrier, broadcast, pmax,
-                         pmean, pmin, ppermute, psum, recv_prev,
-                         reduce_scatter, send_next)
+                         all_to_all, axis_index, barrier, broadcast, gather,
+                         irecv, isend, pmax, pmean, pmin, ppermute, psum,
+                         recv, recv_prev, reduce, reduce_scatter, scatter,
+                         send, send_next)
 from .env import (ParallelEnv, get_rank, get_world_size, hybrid_group,
                   init_parallel_env, is_initialized, set_hybrid_group)
 from .parallelize import (build_eval_step, build_train_step,
@@ -56,5 +57,6 @@ __all__ = [
     # collectives
     "AxisGroup", "ReduceOp", "all_reduce", "all_gather", "reduce_scatter",
     "all_to_all", "broadcast", "ppermute", "send_next", "recv_prev",
+    "send", "recv", "isend", "irecv", "reduce", "gather", "scatter",
     "axis_index", "barrier", "psum", "pmean", "pmax", "pmin",
 ]

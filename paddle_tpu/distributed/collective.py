@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "ReduceOp", "AxisGroup", "all_reduce", "all_gather", "reduce_scatter",
     "all_to_all", "broadcast", "ppermute", "send_next", "recv_prev",
+    "send", "recv", "isend", "irecv", "reduce", "gather", "scatter",
     "axis_index", "barrier", "psum", "pmean", "pmax", "pmin",
 ]
 
@@ -269,6 +270,84 @@ def recv_prev(x, group=None, wrap: bool = True):
         # the wraparound edge (src 0 → dst n-1) is the last element
         perm = perm[:-1]
     return ppermute(x, perm, g)
+
+
+def send(x, dst: int, src: int, group=None):
+    """P2P send (parity: ``paddle.distributed.send``).
+
+    XLA SPMD traces ONE program for every rank, so the transfer's (src, dst)
+    pair must be static — the reference's ``if rank == s: send(...)`` rank
+    branching does not exist here, which is why ``src`` is REQUIRED rather
+    than inferred from a calling rank (a default would silently misroute).
+    Both :func:`send` and :func:`recv` lower to the same one-pair
+    collective-permute; ``dst`` receives ``src``'s shard, every other rank
+    receives zeros.  Pipeline-style full-axis shifts should use
+    :func:`send_next`/:func:`recv_prev` (a single fused collective-permute
+    around the ring) instead of per-pair calls.
+    """
+    return ppermute(x, [(src, dst)], group)
+
+
+def recv(x, src: int, dst: int, group=None):
+    """P2P receive — the matching half of :func:`send` (same lowering;
+    ``dst`` is REQUIRED for the same static-pair reason)."""
+    return ppermute(x, [(src, dst)], group)
+
+
+def isend(x, dst: int, src: int, group=None):
+    """Async send (parity: ``paddle.distributed.isend``).  jax dispatch is
+    asynchronous by construction — the returned array IS the future; calling
+    ``jax.block_until_ready`` on it is the reference's ``task.wait()``."""
+    return send(x, dst, src, group=group)
+
+
+def irecv(x, src: int, dst: int, group=None):
+    """Async receive; see :func:`isend` for the future semantics."""
+    return recv(x, src, dst, group=group)
+
+
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM, group=None):
+    """Rooted reduce (parity: ``paddle.distributed.reduce``).
+
+    GSPMD lowers rooted reductions to a full all-reduce (rank-dependent
+    delivery is a NCCL artifact; on the ICI torus the all-reduce is the same
+    ring pass) — so every rank gets the reduced value, a documented superset
+    of the reference's dst-only contract.
+    """
+    del dst
+    return all_reduce(x, op=op, group=group)
+
+
+def gather(x, dst: int = 0, axis: int = 0, group=None):
+    """Rooted gather (parity: ``paddle.distributed.gather``): every rank
+    gets the concatenation (superset of dst-only delivery, as with
+    :func:`reduce`); shard i lands at position i along ``axis``."""
+    del dst
+    return all_gather(x, axis=axis, group=group, tiled=False)
+
+
+def scatter(x, src: int = 0, axis: int = 0, group=None):
+    """Rooted scatter (parity: ``paddle.distributed.scatter``): rank i
+    receives slice i along ``axis`` of ``src``'s tensor.  Lowered as
+    broadcast-from-src + static slice by rank index — one all-reduce on the
+    wire, XLA dead-code-eliminates the unused slices."""
+    g = _resolve(group)
+
+    def _sc(v):
+        v = lax.psum(jnp.where(axis_index(g) == src, v, jnp.zeros_like(v)),
+                     g.axes)
+        n = 1
+        for a in g.axes:
+            n *= lax.axis_size(a)
+        parts = jnp.split(v, n, axis=axis)
+        return jnp.stack(parts)[axis_index(g)]
+
+    if _in_trace(x):
+        return _sc(x)
+    mesh = _mesh_of(g)
+    spec = P(g.axis if isinstance(g.axis, str) else g.axes)
+    fn = jax.shard_map(_sc, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return fn(x)
 
 
 # -- utilities ---------------------------------------------------------------

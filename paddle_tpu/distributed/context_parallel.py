@@ -22,9 +22,18 @@ from jax.sharding import PartitionSpec as P
 from ..ops.attention import flash_attention
 from ..ops.ring_attention import (ring_attention_shard,
                                   ulysses_attention_shard)
+from ..utils.logging import vlog_once
 from . import env
 
 __all__ = ["context_parallel_attention"]
+
+
+def _fallback(reason: str):
+    """One-shot VLOG(1) when sequence parallelism is requested but inert —
+    the caller gets plain (single-shard) flash attention instead."""
+    vlog_once(1, f"context_parallel:{reason}",
+              f"context_parallel_attention: running plain flash attention "
+              f"({reason})")
 
 
 def context_parallel_attention(q, k, v, causal: bool = True,
@@ -41,6 +50,9 @@ def context_parallel_attention(q, k, v, causal: bool = True,
         raise ValueError(f"mode must be 'ring' or 'ulysses', got {mode!r}")
     m = mesh if mesh is not None else env.active_mesh()
     if m is None or axis not in m.axis_names or m.shape[axis] == 1:
+        _fallback("no active mesh" if m is None
+                  else f"mesh has no {axis!r} axis" if axis not in m.axis_names
+                  else f"{axis!r} degree is 1")
         return flash_attention(q, k, v, causal=causal, scale=scale)
     shard_fn = (ring_attention_shard if mode == "ring"
                 else ulysses_attention_shard)

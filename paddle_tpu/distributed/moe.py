@@ -20,6 +20,17 @@ canonical TPU MoE (GShard paper):
     all_to_all pair the reference codes as global_scatter/global_gather;
   * aux losses in fp32: GShard load-balancing loss and the router z-loss.
 
+Memory envelope of the dense dispatch: it materialises TWO fp32
+``(T, E, C)`` tensors (dispatch + combine), i.e. ``2 * 4 * T * E * C``
+bytes with ``C = ceil(cf * T * k / E)`` — effectively ``8 * cf * k * T²``
+bytes, *quadratic in tokens* and independent of E.  Worked example:
+T = 8192 tokens, E = 64 experts, k = 2, cf = 1.25 → C = 320 and the two
+one-hots cost 8192·64·320·4 B × 2 ≈ **1.34 GB**, dwarfing the (T, D)
+activations (8192·4096·2 B = 64 MB at D = 4096).  For long sequences use
+``dispatch_mode="index"`` — the reference's global_scatter/global_gather is
+index-based too: O(T·k) int32 routing metadata plus the (E, C, D) expert
+batches, no (T, E, C) tensors at all.
+
 Everything is jit-traceable — static shapes, no data-dependent control flow.
 """
 
@@ -32,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .. import flags as _flags
 from ..nn import functional as F
 from ..tensor.math import einsum
 from ..nn import initializer as I
@@ -93,8 +105,13 @@ class MoELayer(Layer):
                  capacity_factor: float = 1.25,
                  eval_capacity_factor: Optional[float] = None,
                  aux_loss_coef: float = 0.01, z_loss_coef: float = 1e-3,
-                 dtype=None):
+                 dispatch_mode: Optional[str] = None, dtype=None):
         super().__init__()
+        if dispatch_mode not in (None, "dense", "index"):
+            raise ValueError(
+                f"dispatch_mode must be 'dense' or 'index', got "
+                f"{dispatch_mode!r}")
+        self.dispatch_mode = dispatch_mode  # None → FLAGS_moe_dispatch
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         self.gate = gate if gate is not None else GShardGate(
@@ -127,36 +144,53 @@ class MoELayer(Layer):
         return max(4, int(math.ceil(tokens * self.top_k * f
                                     / self.num_experts)))
 
-    def _route(self, logits):
-        """(T, E) logits → dispatch (T, E, C), combine (T, E, C), aux."""
+    def _topk_choices(self, logits):
+        """Shared routing core.  (T, E) logits → per-choice lists
+        ``idx`` (T,) int32, ``pos`` (T,) int32 (position within the chosen
+        expert's capacity buffer, first-come-first-served in token order,
+        counting all k choices in priority order), ``gate`` (T,) fp32 —
+        plus the capacity C and the scaled aux loss."""
         t, e = logits.shape
         c = self._capacity(t)
         probs = jax.nn.softmax(logits, axis=-1)          # (T, E) fp32
 
-        gates_list = []
-        masks = []
+        idxs, poss, gates = [], [], []
+        top1_mask = None
+        prior = jnp.zeros((1, e), jnp.float32)
         remaining = probs
         for _ in range(self.top_k):
             idx = jnp.argmax(remaining, axis=-1)          # (T,)
             mask = _one_hot(idx, e)                       # (T, E)
-            gates_list.append((probs * mask).sum(-1))     # (T,)
-            masks.append(mask)
-            remaining = remaining * (1.0 - mask)
-
-        # position within each expert's buffer, first-come-first-served in
-        # token order, counting all k choices in priority order
-        disp = jnp.zeros((t, e, c), jnp.float32)
-        combine = jnp.zeros((t, e, c), jnp.float32)
-        prior = jnp.zeros((t, e), jnp.float32)
-        for k in range(self.top_k):
-            mask = masks[k]
             pos = (jnp.cumsum(mask, axis=0) - mask) + prior  # (T, E)
             prior = prior + mask.sum(0, keepdims=True)
-            keep = (pos < c) * mask                        # under capacity
-            pos_oh = _one_hot(jnp.sum(pos * mask, -1).astype(jnp.int32), c)
-            d_k = keep[:, :, None] * pos_oh[:, None, :]    # (T, E, C)
+            idxs.append(idx.astype(jnp.int32))
+            poss.append(jnp.sum(pos * mask, -1).astype(jnp.int32))
+            gates.append((probs * mask).sum(-1))          # (T,)
+            if top1_mask is None:
+                top1_mask = mask
+            remaining = remaining * (1.0 - mask)
+
+        # aux losses (fp32): GShard load-balance + z-loss
+        me = probs.mean(axis=0)                            # (E,)
+        ce = top1_mask.mean(axis=0)                        # top-1 fraction
+        l_aux = (me * ce).sum() * e * self.aux_loss_coef
+        l_z = (jax.nn.logsumexp(logits, axis=-1) ** 2).mean() \
+            * self.z_loss_coef
+        return c, idxs, poss, gates, l_aux + l_z
+
+    def _route(self, logits):
+        """(T, E) logits → dispatch (T, E, C), combine (T, E, C), aux."""
+        t, e = logits.shape
+        c, idxs, poss, gates, aux = self._topk_choices(logits)
+
+        disp = jnp.zeros((t, e, c), jnp.float32)
+        combine = jnp.zeros((t, e, c), jnp.float32)
+        for k in range(self.top_k):
+            keep = (poss[k] < c).astype(jnp.float32)       # under capacity
+            d_k = (keep[:, None, None] * _one_hot(idxs[k], e)[:, :, None]
+                   * _one_hot(poss[k], c)[:, None, :])     # (T, E, C)
             disp = disp + d_k
-            combine = combine + d_k * gates_list[k][:, None, None]
+            combine = combine + d_k * gates[k][:, None, None]
 
         if self.top_k > 1:
             # normalise combine weights over the kept choices (GShard renorm)
@@ -164,14 +198,7 @@ class MoELayer(Layer):
             combine = combine / jnp.maximum(denom, 1e-9)
         # top-1 keeps the raw gate probability (Switch Transformer): scaling
         # by p is what keeps the router differentiable through the task loss
-
-        # aux losses (fp32): GShard load-balance + z-loss
-        me = probs.mean(axis=0)                            # (E,)
-        ce = masks[0].mean(axis=0)                         # top-1 fraction
-        l_aux = (me * ce).sum() * e * self.aux_loss_coef
-        l_z = (jax.nn.logsumexp(logits, axis=-1) ** 2).mean() \
-            * self.z_loss_coef
-        return disp, combine, l_aux + l_z
+        return disp, combine, aux
 
     # -- forward ------------------------------------------------------------
 
@@ -181,17 +208,62 @@ class MoELayer(Layer):
         u = einsum("ecd,edf->ecf", x, self.up_proj)
         return einsum("ecf,efd->ecd", F.swiglu(g, u), self.down_proj)
 
-    def forward(self, x):
-        """x: (..., D) → (out (..., D), aux_loss scalar)."""
-        shape = x.shape
-        xt = x.reshape(-1, shape[-1])                      # (T, D)
+    def _forward_dense(self, xt):
         logits = self.gate.logits(xt)                      # (T, E) fp32
         disp, combine, aux = self._route(logits)
         # dispatch: (T,E,C) × (T,D) → (E,C,D); XLA emits the alltoall when
         # T is batch-sharded and E is expert-sharded
-        xe = einsum("tec,td->ecd", disp.astype(x.dtype), xt)
+        xe = einsum("tec,td->ecd", disp.astype(xt.dtype), xt)
         xe = constrain(xe, EP_AXES, None, None)
         ye = self._expert(xe)
         ye = constrain(ye, EP_AXES, None, None)
-        out = einsum("tec,ecd->td", combine.astype(x.dtype), ye)
+        return einsum("tec,ecd->td", combine.astype(xt.dtype), ye), aux
+
+    def _forward_index(self, xt):
+        """Index-based dispatch (parity: the reference's global_scatter /
+        global_gather, which exchange tokens by index, not by one-hot).
+
+        Routing metadata is O(T·k) int32 — each kept (token, choice) pair
+        becomes a flat slot ``expert*C + pos`` — and the expert batches are
+        built with a scatter-add and read back with a gather, so nothing of
+        shape (T, E, C) is ever materialised.  Numerically identical to the
+        dense path (parity-tested)."""
+        t, e = xt.shape[0], self.num_experts
+        logits = self.gate.logits(xt)                      # (T, E) fp32
+        c, idxs, poss, gates, aux = self._topk_choices(logits)
+
+        # one scratch row past the real slots absorbs dropped tokens
+        xe_pad = jnp.zeros((e * c + 1, xt.shape[-1]), xt.dtype)
+        keeps = []
+        for k in range(self.top_k):
+            keep = poss[k] < c                             # (T,) bool
+            slot = jnp.where(keep, idxs[k] * c + poss[k], e * c)
+            keeps.append((keep, slot))
+            xe_pad = xe_pad.at[slot].add(xt)
+        ye = self._expert(constrain(xe_pad[:e * c].reshape(e, c, -1),
+                                    EP_AXES, None, None))
+        ye_flat = constrain(ye, EP_AXES, None, None).reshape(e * c, -1)
+
+        out = jnp.zeros_like(xt)
+        denom = jnp.zeros((t,), jnp.float32)
+        for k, (keep, slot) in enumerate(keeps):
+            w = gates[k] * keep                            # (T,) fp32
+            out = out + (ye_flat[jnp.minimum(slot, e * c - 1)]
+                         * w[:, None].astype(xt.dtype))
+            denom = denom + w
+        if self.top_k > 1:                                 # GShard renorm
+            out = out / jnp.maximum(denom, 1e-9)[:, None].astype(xt.dtype)
+        return out, aux
+
+    def forward(self, x):
+        """x: (..., D) → (out (..., D), aux_loss scalar)."""
+        shape = x.shape
+        xt = x.reshape(-1, shape[-1])                      # (T, D)
+        mode = self.dispatch_mode or _flags.flag("moe_dispatch")
+        if mode not in ("dense", "index"):
+            raise ValueError(
+                f"FLAGS_moe_dispatch must be 'dense' or 'index', got "
+                f"{mode!r}")
+        fwd = self._forward_index if mode == "index" else self._forward_dense
+        out, aux = fwd(xt)
         return out.reshape(shape), aux

@@ -100,5 +100,12 @@ DEFINE("allocator_strategy", "xla",
        "parity flag: the reference exposes auto_growth; on TPU, XLA owns memory")
 DEFINE("pallas_interpret", False,
        "run Pallas kernels in interpreter mode (for CPU tests)")
+DEFINE("moe_dispatch", "dense",
+       "MoE dispatch algorithm: 'dense' (one-hot einsum, canonical GSPMD "
+       "alltoall) or 'index' (scatter/gather by slot index, O(T*k) routing "
+       "metadata — the reference's global_scatter/global_gather shape)")
+DEFINE("flash_attention_force", False,
+       "error instead of silently falling back to the XLA reference path "
+       "when the Pallas flash-attention kernel is ineligible")
 DEFINE("flash_attention_block_q", 256, "Pallas flash-attention q block size")
 DEFINE("flash_attention_block_kv", 512, "Pallas flash-attention kv block size")

@@ -26,8 +26,10 @@ from ..framework import random as _random
 __all__ = [
     "linear", "embedding", "relu", "gelu", "silu", "swish", "sigmoid",
     "tanh", "softmax", "log_softmax", "softplus", "leaky_relu", "swiglu",
+    "relu6", "hardswish", "mish", "prelu",
     "dropout", "layer_norm", "rms_norm", "group_norm",
     "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "one_hot",
+    "smooth_l1_loss",
     "scaled_dot_product_attention", "conv2d", "max_pool2d", "avg_pool2d",
     "pad", "unfold", "interpolate",
 ]
@@ -356,3 +358,32 @@ def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
     if data_format == "NCHW":
         y = jnp.moveaxis(y, -1, 1)
     return y.astype(x.dtype)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def prelu(x, weight):
+    return jnp.where(x > 0, x, weight * x)
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean",
+                   delta: float = 1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    if reduction == "none":
+        return loss
+    raise ValueError(f"unknown reduction {reduction!r}")

@@ -6,11 +6,13 @@ lacks a TPU lowering (XLA handles this transparently).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 __all__ = [
     "norm", "t", "transpose", "dist", "cond", "det", "slogdet", "inv",
-    "pinv", "matrix_power", "matrix_rank", "cholesky", "qr", "svd", "eig",
+    "pinv", "matrix_power", "matrix_rank", "cholesky", "cholesky_solve",
+    "lu", "qr", "svd", "eig",
     "eigh", "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq",
     "multi_dot", "matrix_transpose", "householder_product",
 ]
@@ -115,7 +117,6 @@ def solve(x, y):
 
 def triangular_solve(x, y, upper: bool = True, transpose: bool = False,
                      unitriangular: bool = False):
-    import jax
     a = jnp.swapaxes(x, -1, -2) if transpose else x
     return jax.scipy.linalg.solve_triangular(
         a, y, lower=not upper if not transpose else upper,
@@ -132,5 +133,25 @@ def multi_dot(arrays):
 
 
 def householder_product(x, tau):
-    import jax
     return jax.lax.linalg.householder_product(x, tau)
+
+
+def cholesky_solve(x, y, upper: bool = False):
+    """Solve A @ out = x given the Cholesky factor ``y`` of A."""
+    L = jnp.swapaxes(y, -1, -2).conj() if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(L, -1, -2).conj(), z, lower=False)
+
+
+def lu(x, pivot: bool = True, get_infos: bool = False):
+    """LU factorisation; pivots are 1-indexed (paddle/torch convention)."""
+    if not pivot:
+        raise NotImplementedError("pivot=False is not supported (XLA's LU "
+                                  "is always partial-pivoted)")
+    lu_mat, piv, _ = jax.lax.linalg.lu(x)
+    piv = piv.astype(jnp.int32) + 1
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], jnp.int32)
+        return lu_mat, piv, info
+    return lu_mat, piv

@@ -21,7 +21,7 @@ __all__ = [
     "expand", "expand_as", "broadcast_to", "gather", "gather_nd", "scatter",
     "scatter_nd_add", "index_select", "masked_select", "take_along_axis",
     "put_along_axis", "repeat_interleave", "unbind", "unstack", "unique",
-    "cast", "slice", "strided_slice", "as_strided", "view",
+    "cast", "slice", "strided_slice", "as_strided", "view", "masked_fill",
 ]
 
 
@@ -238,3 +238,7 @@ def as_strided(x, shape, stride, offset: int = 0):
         idx = idx + jnp.expand_dims(
             r, tuple(i for i in range(len(shape)) if i != d))
     return flat[idx]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)

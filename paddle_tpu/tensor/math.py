@@ -27,6 +27,7 @@ __all__ = [
     "clip", "sum", "nansum", "mean", "nanmean", "prod", "max", "min",
     "amax", "amin", "cumsum", "cumprod", "cummax", "cummin", "logsumexp",
     "logcumsumexp", "count_nonzero", "all", "any", "diff", "trace",
+    "stanh", "trapezoid", "vander",
 ]
 
 
@@ -427,3 +428,32 @@ def diff(x, n: int = 1, axis: int = -1):
 
 def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
     return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def trapezoid(y, x=None, dx=None, axis: int = -1):
+    if x is not None and dx is not None:
+        raise ValueError("pass either x or dx, not both")
+    y = jnp.asarray(y)
+    y0 = jnp.take(y, jnp.arange(y.shape[axis] - 1), axis=axis)
+    y1 = jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = (jnp.take(x, jnp.arange(1, x.shape[axis]), axis=axis)
+             - jnp.take(x, jnp.arange(x.shape[axis] - 1), axis=axis))
+    else:
+        d = 1.0 if dx is None else dx
+    return (0.5 * d * (y0 + y1)).sum(axis=axis)
+
+
+def vander(x, n=None, increasing: bool = False):
+    n = x.shape[0] if n is None else n
+    powers = jnp.arange(n) if increasing else jnp.arange(n - 1, -1, -1)
+    return x[:, None] ** powers[None, :]

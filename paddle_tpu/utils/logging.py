@@ -45,3 +45,15 @@ def VLOG(level: int, msg: str, *args) -> None:
     """Emit ``msg`` when ``level <= GLOG_v`` — the reference's VLOG(n)."""
     if level <= vlog_level():
         get_logger().info("[v%d] " + msg, level, *args)
+
+
+_vlog_once_seen: set = set()
+
+
+def vlog_once(level: int, key: str, msg: str) -> None:
+    """VLOG that fires at most once per distinct ``key`` per process —
+    for fallback/perf-cliff warnings that would otherwise spam every call
+    site (the reference's LOG_FIRST_N(1) convention)."""
+    if key not in _vlog_once_seen:
+        _vlog_once_seen.add(key)
+        VLOG(level, msg)

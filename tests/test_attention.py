@@ -97,3 +97,66 @@ def test_dispatcher_matches_reference():
     b = flash_attention_reference(q, k, v, causal=True, return_lse=False)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
                                atol=1e-5)
+
+
+# -- fallback observability (round-2 verdict weak #3) -------------------------
+
+def test_fallback_warns_once_with_reason(monkeypatch):
+    from paddle_tpu import flags
+    from paddle_tpu.ops import attention
+    from paddle_tpu.utils import get_logger
+
+    records = []
+    monkeypatch.setattr(get_logger(), "info",
+                        lambda msg, *a: records.append(msg % a))
+    monkeypatch.setenv("GLOG_v", "1")
+    from paddle_tpu.utils import logging as ptlog
+    monkeypatch.setattr(ptlog, "_vlog_once_seen", set())
+    monkeypatch.setattr(attention._dispatch, "use_pallas", lambda: True)
+    flags.set_flags({"pallas_interpret": True})
+    try:
+        q, k, v = (jnp.asarray(_rand((1, 8, 2, 16), i + 60)) for i in range(3))
+        mask = jnp.ones((1, 2, 8, 8), bool)
+        flash_attention(q, k, v, attn_mask=mask)   # ineligible: custom mask
+        flash_attention(q, k, v, attn_mask=mask)   # same reason → no repeat
+        hits = [r for r in records if "falling back" in r]
+        assert len(hits) == 1 and "custom attn_mask" in hits[0]
+        flash_attention(q, k, v, dropout_p=0.5)    # new reason → new warning
+        hits = [r for r in records if "falling back" in r]
+        assert len(hits) == 2 and "dropout_p" in hits[1]
+    finally:
+        flags.set_flags({"pallas_interpret": False})
+
+
+def test_fallback_force_flag_errors(monkeypatch):
+    import pytest
+
+    from paddle_tpu import flags
+    from paddle_tpu.ops import attention
+
+    monkeypatch.setattr(attention._dispatch, "use_pallas", lambda: True)
+    flags.set_flags({"flash_attention_force": True})
+    try:
+        q, k, v = (jnp.asarray(_rand((1, 8, 2, 16), i + 70)) for i in range(3))
+        with pytest.raises(RuntimeError, match="custom attn_mask"):
+            flash_attention(q, k, v, attn_mask=jnp.ones((1, 2, 8, 8), bool))
+    finally:
+        flags.set_flags({"flash_attention_force": False})
+
+
+def test_context_parallel_fallback_warns(monkeypatch):
+    from paddle_tpu.distributed import context_parallel
+    from paddle_tpu.utils import get_logger
+
+    records = []
+    monkeypatch.setattr(get_logger(), "info",
+                        lambda msg, *a: records.append(msg % a))
+    monkeypatch.setenv("GLOG_v", "1")
+    from paddle_tpu.utils import logging as ptlog
+    monkeypatch.setattr(ptlog, "_vlog_once_seen", set())
+    monkeypatch.setattr(context_parallel.env, "active_mesh", lambda: None)
+    q, k, v = (jnp.asarray(_rand((1, 8, 2, 16), i + 80)) for i in range(3))
+    context_parallel.context_parallel_attention(q, k, v)
+    context_parallel.context_parallel_attention(q, k, v)
+    hits = [r for r in records if "plain flash attention" in r]
+    assert len(hits) == 1 and "no active mesh" in hits[0]

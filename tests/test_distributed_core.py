@@ -218,3 +218,69 @@ def test_all_reduce_eager(hcg8):
 
 def test_barrier_eager(hcg8):
     dist.barrier(group=dist.AxisGroup("mp", hcg8.mesh))  # must not hang
+
+
+# -- p2p + rooted collectives (parity: paddle.distributed send/recv/reduce/
+#    gather/scatter — see collective.py for the SPMD delivery semantics) ----
+
+def test_send_recv_pair(hcg8):
+    x = jnp.arange(8.0)
+
+    def f(v):
+        return dist.recv(v, src=1, dst=3, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P("mp"))(x)
+    out = np.asarray(out).reshape(4, 2)
+    np.testing.assert_allclose(out[3], [2.0, 3.0])   # src 1's shard
+    for r in (0, 1, 2):
+        np.testing.assert_allclose(out[r], 0.0)      # everyone else: zeros
+    # send is the same lowering
+    out2 = jax.shard_map(lambda v: dist.send(v, dst=3, src=1, group="mp"),
+                         mesh=hcg8.mesh, in_specs=P("mp"),
+                         out_specs=P("mp"))(x)
+    np.testing.assert_allclose(np.asarray(out2), out.reshape(-1))
+    # isend/irecv: same values, future == the array itself
+    out3 = jax.block_until_ready(
+        jax.shard_map(lambda v: dist.irecv(v, src=1, dst=3, group="mp"),
+                      mesh=hcg8.mesh, in_specs=P("mp"),
+                      out_specs=P("mp"))(x))
+    np.testing.assert_allclose(np.asarray(out3), out.reshape(-1))
+
+
+def test_rooted_reduce_and_gather(hcg8):
+    x = jnp.arange(8.0)
+
+    def f(v):
+        return dist.reduce(v, dst=0, op=dist.ReduceOp.SUM, group="mp")
+
+    out = jax.shard_map(f, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P())(x)
+    np.testing.assert_allclose(out, np.arange(8.0).reshape(4, 2).sum(0))
+
+    def g(v):
+        return dist.gather(v, dst=0, group="mp")
+
+    out = jax.shard_map(g, mesh=hcg8.mesh, in_specs=P("mp"),
+                        out_specs=P(), check_vma=False)(x)
+    # tiled=False: (ranks, shard) stacking
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(8.0).reshape(4, 2))
+
+
+def test_rooted_scatter(hcg8):
+    # src rank 2 holds the payload; each rank i should end up with slice i
+    payload = np.arange(16.0).reshape(4, 4)
+
+    def f(v):
+        return dist.scatter(v, src=2, axis=0, group="mp")
+
+    # per-rank input: rank r sees payload iff r == 2, else garbage
+    stacked = np.stack([payload if r == 2 else np.full_like(payload, -7.0)
+                        for r in range(4)])   # (4, 4, 4) → P("mp") on axis 0
+    out = jax.shard_map(f, mesh=hcg8.mesh,
+                        in_specs=P("mp"), out_specs=P("mp"))(
+        jnp.asarray(stacked.reshape(16, 4)))
+    got = np.asarray(out).reshape(4, 1, 4)    # rank-major slices
+    for r in range(4):
+        np.testing.assert_allclose(got[r, 0], payload[r])

@@ -1,0 +1,158 @@
+"""Op-surface parity registry: coverage accounting + oracles for ops added
+to close registry gaps.  Pattern: the reference's declarative op list
+(paddle/phi/ops/yaml, upstream layout) is the ground truth of what the op
+surface is; here the registry resolves every target name against the real
+modules so claims can't drift from code."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import op_registry
+
+# Regression floor: per-category implemented counts as of round 3.
+# If a refactor drops an op, this fails loudly instead of silently
+# shrinking the surface.  Raise these when coverage grows.
+FLOOR = {
+    "paddle.creation": 20,
+    "paddle.manipulation": 34,
+    "paddle.math": 92,
+    "paddle.logic": 22,
+    "paddle.search": 15,
+    "paddle.random": 12,
+    "paddle.linalg": 26,
+    "paddle.nn.functional": 33,
+    "paddle.incubate": 6,
+    "paddle.distributed": 13,
+}
+
+
+def test_registry_counts_do_not_regress(capsys):
+    cov = op_registry.coverage()
+    assert set(cov) == set(FLOOR)
+    print(op_registry.report())  # recorded in CI logs with -s
+    for cat, floor in FLOOR.items():
+        impl, total, absent = cov[cat]
+        assert impl >= floor, (
+            f"{cat}: implemented count fell to {impl} (< floor {floor}); "
+            f"absent: {absent}")
+
+
+def test_registry_resolves_to_callables():
+    for cat, table in op_registry.resolve().items():
+        for name, fn in table.items():
+            if fn is not None:
+                assert callable(fn), f"{cat}.{name} resolved to non-callable"
+
+
+def test_registry_is_honest_about_absences():
+    """Every name must be a real lookup, not hand-marked: spot-check that a
+    bogus name would come back absent rather than crashing."""
+    op_registry.TARGET_SURFACE["paddle.math"].append("definitely_not_an_op")
+    try:
+        cov = op_registry.coverage()
+        assert "definitely_not_an_op" in cov["paddle.math"][2]
+    finally:
+        op_registry.TARGET_SURFACE["paddle.math"].remove("definitely_not_an_op")
+
+
+# -- oracles for the round-3 gap-closing ops ---------------------------------
+
+def test_stanh_trapezoid_vander():
+    from paddle_tpu.tensor import math as M
+
+    x = np.linspace(-2, 2, 7).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(M.stanh(jnp.asarray(x))),
+                               1.7159 * np.tanh(0.67 * x), rtol=1e-6)
+    y = np.random.RandomState(0).rand(3, 8).astype(np.float32)
+    xs = np.sort(np.random.RandomState(1).rand(8)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(M.trapezoid(jnp.asarray(y), x=jnp.asarray(xs), axis=-1)),
+        np.trapezoid(y, x=xs, axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(M.trapezoid(jnp.asarray(y), dx=0.5, axis=0)),
+        np.trapezoid(y, dx=0.5, axis=0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        M.trapezoid(jnp.asarray(y), x=jnp.asarray(xs), dx=1.0)
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(np.asarray(M.vander(jnp.asarray(v))),
+                               np.vander(v), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(M.vander(jnp.asarray(v), n=4, increasing=True)),
+        np.vander(v, 4, increasing=True), rtol=1e-6)
+
+
+def test_masked_fill():
+    from paddle_tpu.tensor.manipulation import masked_fill
+
+    x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    mask = jnp.asarray([[True, False, True], [False, True, False]])
+    out = masked_fill(x, mask, -1.0)
+    np.testing.assert_allclose(
+        np.asarray(out), [[-1, 1, -1], [3, -1, 5]])
+
+
+def test_activation_ops():
+    import paddle_tpu.nn.functional as F
+
+    x = np.linspace(-4, 4, 9).astype(np.float32)
+    xj = jnp.asarray(x)
+    np.testing.assert_allclose(np.asarray(F.relu6(xj)),
+                               np.clip(x, 0, 6), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(F.hardswish(xj)),
+                               x * np.clip(x + 3, 0, 6) / 6, rtol=1e-6)
+    sp = np.log1p(np.exp(x))
+    np.testing.assert_allclose(np.asarray(F.mish(xj)), x * np.tanh(sp),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(F.prelu(xj, 0.25)),
+                               np.where(x > 0, x, 0.25 * x), rtol=1e-6)
+
+
+def test_smooth_l1_loss():
+    import paddle_tpu.nn.functional as F
+
+    a = np.array([0.0, 1.0, 3.0], np.float32)
+    b = np.array([0.5, 1.0, 0.0], np.float32)
+    d = np.abs(a - b)
+    want = np.where(d < 1.0, 0.5 * d * d, d - 0.5)
+    np.testing.assert_allclose(
+        np.asarray(F.smooth_l1_loss(jnp.asarray(a), jnp.asarray(b),
+                                    reduction="none")), want, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(F.smooth_l1_loss(jnp.asarray(a), jnp.asarray(b))),
+        want.mean(), rtol=1e-6)
+    with pytest.raises(ValueError):
+        F.smooth_l1_loss(jnp.asarray(a), jnp.asarray(b), reduction="bogus")
+
+
+def test_cholesky_solve_and_lu():
+    from paddle_tpu.tensor import linalg as L
+
+    rng = np.random.RandomState(3)
+    a = rng.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    b = rng.randn(4, 2).astype(np.float32)
+    chol = np.linalg.cholesky(spd)
+    out = L.cholesky_solve(jnp.asarray(b), jnp.asarray(chol))
+    np.testing.assert_allclose(np.asarray(out), np.linalg.solve(spd, b),
+                               rtol=1e-3, atol=1e-4)
+    # upper-factor form
+    out_u = L.cholesky_solve(jnp.asarray(b), jnp.asarray(chol.T), upper=True)
+    np.testing.assert_allclose(np.asarray(out_u), np.linalg.solve(spd, b),
+                               rtol=1e-3, atol=1e-4)
+
+    lu_mat, piv = L.lu(jnp.asarray(a))
+    # reconstruct: P @ L @ U == a, pivots are 1-indexed row swaps
+    lu_np, piv_np = np.asarray(lu_mat), np.asarray(piv) - 1
+    l = np.tril(lu_np, -1) + np.eye(4)
+    u = np.triu(lu_np)
+    perm = np.arange(4)
+    for i, p in enumerate(piv_np):
+        perm[[i, p]] = perm[[p, i]]
+    recon = np.empty_like(a)
+    recon[perm] = (l @ u)
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-5)
+    lu3 = L.lu(jnp.asarray(a), get_infos=True)
+    assert len(lu3) == 3 and int(lu3[2]) == 0
+    with pytest.raises(NotImplementedError):
+        L.lu(jnp.asarray(a), pivot=False)
