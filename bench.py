@@ -84,6 +84,21 @@ def measure(layers, vocab, batch, seq, steps, warmup, on_tpu):
     b = dist.shard_batch({"input_ids": jnp.asarray(ids[:, :-1]),
                           "labels": jnp.asarray(ids[:, 1:])}, hcg)
     key = jax.random.key(0)
+    # HBM accounting: runtime peak_bytes_in_use when the backend exposes it;
+    # the axon tunnel does not (memory_stats() → None), so fall back to
+    # XLA's compile-time analysis of the step (resident args + transient
+    # temp) — an estimate the compiler itself allocates by, not a guess
+    hbm = {}
+    try:
+        compiled = step.lower(params, opt_state, b, key).compile()
+        ma = compiled.memory_analysis()
+        hbm = {"args": int(ma.argument_size_in_bytes),
+               "temp": int(ma.temp_size_in_bytes),
+               "output": int(ma.output_size_in_bytes),
+               "source": "xla_memory_analysis"}
+        step = compiled  # AOT executable: don't pay a second jit compile
+    except Exception:
+        pass
     loss = None
     for i in range(warmup):
         loss, params, opt_state = step(params, opt_state, b,
@@ -95,14 +110,18 @@ def measure(layers, vocab, batch, seq, steps, warmup, on_tpu):
                                        jax.random.fold_in(key, warmup + i))
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    return (dt / steps, float(loss), n, cfg.hidden_size)
+    ms = jax.local_devices()[0].memory_stats() or {}
+    if ms.get("peak_bytes_in_use"):
+        hbm = {"peak": int(ms["peak_bytes_in_use"]),
+               "source": "runtime_memory_stats"}
+    return (dt / steps, float(loss), n, cfg.hidden_size, hbm)
 
 
 def run_single(args):
     """--single mode: one measurement in this process, one JSON line out."""
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"
-    step_time, loss, n, hidden = measure(
+    step_time, loss, n, hidden, hbm = measure(
         args.layers, args.vocab, args.batch, args.seq,
         args.steps, args.warmup, on_tpu)
     tokens = args.batch * args.seq
@@ -111,6 +130,7 @@ def run_single(args):
              "batch": args.batch, "seq": args.seq, "params": n,
              "step_time_s": round(step_time, 4),
              "tokens_per_sec_per_chip": round(tokens / step_time / n_chips),
+             "hbm": hbm,
              "loss": round(loss, 4)}
     if args.peak_flops:
         f_6nd = 6.0 * n * tokens
@@ -164,8 +184,8 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if not on_tpu:  # tiny in-process smoke on CPU
-        step_time, loss, n, _ = measure(2, 256, args.batch or 8,
-                                        args.seq or 64, 5, 2, False)
+        step_time, loss, n, _, _ = measure(2, 256, args.batch or 8,
+                                           args.seq or 64, 5, 2, False)
         tokens = (args.batch or 8) * (args.seq or 64)
         print(json.dumps({
             "metric": "tokens_per_sec_per_chip_tiny_cpu",
@@ -202,16 +222,49 @@ def main():
     if not curve:
         raise RuntimeError("no benchmark config completed")
 
+    # ≥3-point depth curve: deepest, midpoint, half (round-2 verdict #3).
+    # Going deeper than the stretch is arithmetic, not will: at vocab 4096
+    # even 6 layers is 1.34e9 params x 14 B = 18.8 GB > one v5e's HBM, so
+    # extra points come from the shallow side; a deep-narrow stretch
+    # (vocab 4096, seq 1024) is still attempted and kept if it survives.
     deepest = curve[0]
     half = max(1, deepest["layers"] // 2)
-    if half != deepest["layers"]:
-        p = spawn_point(half, vocab, batch, seq, args.steps, args.warmup,
+    extra = sorted({half, (deepest["layers"] + half) // 2}
+                   - {deepest["layers"]}, reverse=True)
+    for d in extra:
+        p = spawn_point(d, vocab, batch, seq, args.steps, args.warmup,
                         peak_flops)
+        if p is not None:
+            curve.append(p)
+    if on_tpu and not args.layers:
+        p = spawn_point(deepest["layers"] + 1, 4096, batch, 1024,
+                        args.steps, args.warmup, peak_flops)
         if p is not None:
             curve.append(p)
 
     head = curve[0]
-    out = {"metric": "mfu_llama3_8b_arch", "value": head["mfu_6nd"],
+    # honest label: the metric names the MEASURED size; full-depth numbers
+    # are a clearly-marked extrapolation of the depth curve, not the value
+    name = f"mfu_llama3_arch_{round(head['params'] / 1e6)}m"
+    same_cfg = [p for p in curve
+                if p["vocab"] == head["vocab"] and p["seq"] == head["seq"]]
+    extrap = None
+    if len(same_cfg) >= 2:
+        import math
+        xs = [math.log2(p["layers"]) for p in same_cfg]
+        ys = [p["mfu_6nd"] for p in same_cfg]
+        n_pts = len(xs)
+        mx, my = sum(xs) / n_pts, sum(ys) / n_pts
+        denom = sum((x - mx) ** 2 for x in xs)
+        slope = (sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+                 if denom else 0.0)
+        extrap = {
+            "layers": 32,
+            "mfu_6nd": round(my + slope * (math.log2(32) - mx), 4),
+            "method": f"linear fit of mfu vs log2(depth) over "
+                      f"{n_pts} measured points — an estimate, not a "
+                      f"measurement (32 layers do not fit one chip's HBM)"}
+    out = {"metric": name, "value": head["mfu_6nd"],
            "unit": "fraction_of_peak_bf16",
            "vs_baseline": round(head["mfu_6nd"] / 0.45, 4),
            "detail": {
@@ -221,6 +274,7 @@ def main():
                    "mfu_6nd": "6*N*D, no attention FLOPs",
                    "mfu_attn": "6*N*D + 12*L*H*S^2*B, causal not halved",
                    "peak_bf16_flops": peak_flops},
+               "extrapolation_8b_depth": extrap,
                "curve": curve}}
     print(json.dumps(out))
 

@@ -13,7 +13,7 @@ analysis), designed jax/XLA/Pallas/pjit-first rather than ported:
 """
 
 from . import (amp, distributed, flags, framework, hapi, inference, io,
-               jit, metric, nn, optimizer, profiler, tensor, utils)
+               jit, metric, nn, optimizer, profiler, static, tensor, utils)
 from .framework import (device_count, get_default_dtype, is_compiled_with_tpu,
                         load, save, seed, set_default_dtype, to_tensor)
 from .flags import get_flags, set_flags

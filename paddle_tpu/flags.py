@@ -108,4 +108,8 @@ DEFINE("flash_attention_force", False,
        "error instead of silently falling back to the XLA reference path "
        "when the Pallas flash-attention kernel is ineligible")
 DEFINE("flash_attention_block_q", 256, "Pallas flash-attention q block size")
+DEFINE("rms_norm_pallas_min_dim", 32768,
+       "route standalone rms_norm rows at least this long to the Pallas "
+       "single-visit kernel; threshold set from v5e measurements "
+       "(ops/norms.py docstring) — below it XLA is as fast or faster")
 DEFINE("flash_attention_block_kv", 512, "Pallas flash-attention kv block size")

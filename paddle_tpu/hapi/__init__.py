@@ -48,8 +48,17 @@ class Model:
         self.loss = loss
         ms = metrics if metrics is not None else []
         self.metrics = ms if isinstance(ms, (list, tuple)) else [ms]
+        from ..distributed import env as dist_env
+
         self._params = self.network.trainable_state()
-        if optimizer is not None:
+        # mesh-aware _build_step gets sharded params/opt-state from
+        # build_train_step; initialising full host state here first would
+        # waste the exact memory the mesh path exists to shard — but that
+        # only applies when the mesh step IS built (loss present), else
+        # init eagerly as before so opt_state_dict()/save() keep working
+        will_build_mesh_step = (loss is not None and optimizer is not None
+                                and dist_env.hybrid_group() is not None)
+        if optimizer is not None and not will_build_mesh_step:
             self._opt_state = optimizer.init(self._params)
         if loss is not None and optimizer is not None:
             self._train_step = self._build_step()
@@ -57,6 +66,30 @@ class Model:
 
     def _build_step(self):
         net, loss_fn, opt = self.network, self.loss, self.optimizer
+
+        # mesh-aware path: when fleet/init_parallel_env set up a hybrid
+        # group, ride the same GSPMD train step the low-level API uses —
+        # params laid out per their PartitionSpecs, optimizer state per the
+        # strategy's ZeRO stage, batch sharded over dp×sharding.  The
+        # reference's Model.fit likewise trains whatever fleet wrapped.
+        from ..distributed import env as dist_env
+
+        hcg = dist_env.hybrid_group()
+        if hcg is not None:
+            from ..distributed.parallelize import build_train_step
+
+            dist_step, self._params, self._opt_state = build_train_step(
+                net, opt,
+                loss_fn=lambda m, batch: loss_fn(m(batch["x"]), batch["y"]),
+                hcg=hcg)
+            self._batch_prep = self._shard_batch_fn(hcg)
+
+            def step(p, o, x, y, rng):
+                return dist_step(p, o, {"x": x, "y": y}, rng)
+
+            return step
+
+        self._batch_prep = None
 
         def call_loss(p, x, y, rng):
             with bind_params(net, p, rng=rng):
@@ -68,6 +101,13 @@ class Model:
             return loss, new_p, new_o
 
         return jax.jit(step, donate_argnums=(0, 1))
+
+    @staticmethod
+    def _shard_batch_fn(hcg):
+        from ..distributed.parallelize import shard_batch
+
+        return lambda x, y: shard_batch({"x": jnp.asarray(x),
+                                         "y": jnp.asarray(y)}, hcg)
 
     # -- loops ---------------------------------------------------------------
 
@@ -93,9 +133,13 @@ class Model:
             for i, (x, y) in enumerate(train_data):
                 cbs.on_train_batch_begin(i)
                 self._rng, sub = jax.random.split(self._rng)
+                if getattr(self, "_batch_prep", None) is not None:
+                    b = self._batch_prep(x, y)
+                    x, y = b["x"], b["y"]
+                else:
+                    x, y = jnp.asarray(x), jnp.asarray(y)
                 loss, self._params, self._opt_state = self._train_step(
-                    self._params, self._opt_state, jnp.asarray(x),
-                    jnp.asarray(y), sub)
+                    self._params, self._opt_state, x, y, sub)
                 losses.append(float(loss))
                 logs = {"loss": losses[-1]}
                 cbs.on_train_batch_end(i, logs)

@@ -123,13 +123,22 @@ class ErnieMoEDecoderLayer(Layer):
     def forward(self, x, rope_cache, position_ids=None):
         h = x + self.self_attn(self.input_layernorm(x), rope_cache,
                                position_ids)
-        y = self.post_attention_layernorm(h)
+        return self._ffn(h, self.post_attention_layernorm(h))
+
+    def _ffn(self, h, y):
         if self.is_moe:
             moe_out, aux = self.moe(y)
             if hasattr(self, "shared_expert"):
                 moe_out = moe_out + self.shared_expert(y)
             return h + moe_out, aux
         return h + self.mlp(y), jnp.zeros((), jnp.float32)
+
+    def decode(self, x, rope_cache, pos, k_cache, v_cache):
+        a, k_cache, v_cache = self.self_attn.decode(
+            self.input_layernorm(x), rope_cache, pos, k_cache, v_cache)
+        h = x + a
+        out, _ = self._ffn(h, self.post_attention_layernorm(h))
+        return out, k_cache, v_cache
 
 
 class ErnieMoEModel(Layer):
@@ -170,6 +179,17 @@ class ErnieMoEModel(Layer):
             aux_total = aux_total + aux
         return self.norm(x), aux_total
 
+    def decode(self, input_ids, cache, pos):
+        """Cache-carrying decode (same stacked-cache layout as LlamaModel;
+        see models/generation.py).  Returns (hidden, cache)."""
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        rope = (self.rope_cos, self.rope_sin)
+        for i, block in enumerate(self.layers):
+            x, k_c, v_c = block.decode(x, rope, pos, cache[i, 0],
+                                       cache[i, 1])
+            cache = cache.at[i, 0].set(k_c).at[i, 1].set(v_c)
+        return self.norm(x), cache
+
 
 class ErnieMoEForCausalLM(Layer):
     """Causal LM over the MoE decoder; loss = CE + router aux losses."""
@@ -191,3 +211,16 @@ class ErnieMoEForCausalLM(Layer):
     def compute_loss(self, input_ids, labels, position_ids=None):
         logits, aux = self.forward(input_ids, position_ids)
         return causal_lm_loss(logits, labels) + aux
+
+    def decode_step(self, input_ids, cache, pos):
+        """(logits, cache) — the generation hook (router aux losses are a
+        training quantity and are dropped at decode time)."""
+        hidden, cache = self.model.decode(input_ids, cache, pos)
+        from ..tensor.math import matmul
+        return matmul(hidden, self.lm_head), cache
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kw):
+        """Greedy/sampled generation with the pre-allocated KV cache (see
+        :func:`paddle_tpu.models.generation.greedy_generate`)."""
+        from .generation import greedy_generate
+        return greedy_generate(self, input_ids, max_new_tokens, **kw)

@@ -1,0 +1,142 @@
+"""Autoregressive decoding with a pre-allocated KV cache.
+
+TPU-native equivalent of the reference's inference decode loop (upstream
+layout: paddle/fluid/inference/ + PaddleNLP's generation_utils — cache-
+carrying incremental decode behind ``model.generate``).
+
+Design — everything is shaped for XLA's static-shape compilation model:
+
+  * the cache is ONE stacked array ``(layers, 2, batch, max_len, kv_heads,
+    head_dim)`` (k at index 0, v at index 1), pre-allocated once; each step
+    writes via ``lax.dynamic_update_slice`` — no concatenation, no shape
+    growth, no per-step recompilation.  The stacked layout (vs a per-layer
+    pytree) also makes the decode step exportable through ``jit.save`` as a
+    plain positional array with a *symbolic* cache-length dimension;
+  * the decode loop is a ``lax.scan`` carrying (cache, position, last token,
+    done-mask) — one compiled program for the whole generation, the
+    while-loop-free form XLA pipelines best;
+  * attention over the cache masks key slots ``> position`` explicitly
+    (the tail of the cache is uninitialised).  Decode attention is
+    DMA-bound (q_len ∈ {1, prompt}), so it runs the XLA math path — the
+    Pallas flash kernel is a throughput kernel for training shapes;
+  * EOS handling is maskwise (``done`` flag per row, finished rows emit
+    ``pad_token_id``) — no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer as _Layer
+
+
+def init_kv_cache(config, batch_size: int, max_length: int, dtype=None):
+    """Pre-allocated cache: (L, 2, B, max_len, kv_heads, head_dim)."""
+    dt = dtype if dtype is not None else config.dtype
+    return jnp.zeros((config.num_hidden_layers, 2, batch_size, max_length,
+                      config.num_key_value_heads, config.head_dim), dt)
+
+
+def cache_mask(pos, q_len: int, kv_len: int):
+    """Bool (1, 1, q_len, kv_len) mask: query i (global position pos+i) may
+    attend to cache slot j iff j <= pos+i (causal + don't read the
+    uninitialised tail)."""
+    qi = pos + jnp.arange(q_len)[:, None]
+    kj = jnp.arange(kv_len)[None, :]
+    return (kj <= qi)[None, None]
+
+
+def greedy_generate(model, input_ids, max_new_tokens: int,
+                    eos_token_id: Optional[int] = None,
+                    pad_token_id: int = 0,
+                    temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    seed: int = 0,
+                    max_length: Optional[int] = None):
+    """Generate ``max_new_tokens`` continuations for a batch of prompts.
+
+    ``model`` must expose ``decode_step(input_ids, cache, pos) ->
+    (logits, cache)`` and ``.config``.  ``temperature == 0`` is greedy
+    (the parity-tested path); ``temperature > 0`` samples, optionally
+    top-k-truncated.  Returns int32 (batch, prompt_len + max_new_tokens);
+    rows that hit ``eos_token_id`` are padded with ``pad_token_id``.
+    """
+    from ..nn.layer import bind_params
+
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, s = input_ids.shape
+    total = max_length if max_length is not None else s + max_new_tokens
+    if total < s + max_new_tokens:
+        raise ValueError(f"max_length {total} < prompt {s} + "
+                         f"max_new_tokens {max_new_tokens}")
+    limit = getattr(model.config, "max_position_embeddings", None)
+    if limit is not None and total > limit:
+        # past the RoPE cache jnp.take would CLAMP position ids (jax's
+        # out-of-bounds gather mode) — silently wrong rotations, so refuse
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds the model's "
+            f"max_position_embeddings ({limit})")
+    cache = init_kv_cache(model.config, b, total)
+    params = model.state_dict(include_buffers=True)
+
+    def pick(logits, key):
+        logits = logits.astype(jnp.float32)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    # NOTE: jitted per generate() call (the model closure is rebound);
+    # inside the jit the whole loop is ONE compiled scan — no per-token
+    # dispatch, no per-step recompilation.
+    @jax.jit
+    def run(params, input_ids, cache, key):
+        with bind_params(model, params):
+            # prefill: one pass over the whole prompt
+            logits, cache = model.decode_step(input_ids, cache, jnp.int32(0))
+            key, sub = jax.random.split(key)
+            nxt = pick(logits[:, -1], sub)
+            done = jnp.zeros((b,), bool)
+            if eos_token_id is not None:
+                done = nxt == eos_token_id
+
+            def step(carry, _):
+                cache, pos, tok, done, key = carry
+                logits, cache = model.decode_step(tok[:, None], cache, pos)
+                key, sub = jax.random.split(key)
+                new = pick(logits[:, -1], sub)
+                if eos_token_id is not None:
+                    new = jnp.where(done, pad_token_id, new)
+                    done = done | (new == eos_token_id)
+                return (cache, pos + 1, new, done, key), tok
+
+            carry = (cache, jnp.int32(s), nxt, done, key)
+            carry, toks = jax.lax.scan(step, carry, None,
+                                       length=max_new_tokens - 1)
+            # toks[i] is the token fed INTO step i; the final carry token
+            # is the last generated one → exactly max_new_tokens total
+            return jnp.concatenate([toks.T, carry[2][:, None]], axis=1)
+
+    out = run(params, input_ids, cache, jax.random.key(seed))
+    return jnp.concatenate([input_ids, out], axis=1)
+
+
+class DecodeStep(_Layer):
+    """Exportable decode step: wraps a causal LM so ``jit.save`` can AOT-
+    compile ``(input_ids, cache, pos) -> (logits, cache)`` to StableHLO —
+    the serving artifact (parity: the reference's inference program with
+    CacheKV inputs).  The cache-length dim may be symbolic (``None`` in the
+    InputSpec), so ONE artifact serves any max_length."""
+
+    def __init__(self, lm):
+        super().__init__()
+        self.lm = lm
+
+    def forward(self, input_ids, cache, pos):
+        return self.lm.decode_step(input_ids, cache, pos)

@@ -137,7 +137,7 @@ class LlamaAttention(Layer):
             (nh * hd, c.hidden_size), dtype=c.dtype, initializer=init,
             sharding=P("mp", "sharding"), attr_name="o_proj")
 
-    def forward(self, x, rope_cache, position_ids=None, kv_cache=None):
+    def _qkv(self, x, rope_cache, position_ids=None):
         c = self.config
         b, s, _ = x.shape
         q = matmul(x, self.q_proj).reshape(b, s, c.num_attention_heads,
@@ -148,13 +148,14 @@ class LlamaAttention(Layer):
                                            c.head_dim)
         cos, sin = rope_cache
         q, k = fused_rope(q, k, cos, sin, position_ids)
-        if kv_cache is not None:  # decode path: append to cache
-            pk, pv = kv_cache
-            k = jnp.concatenate([pk, k], axis=1)
-            v = jnp.concatenate([pv, v], axis=1)
-            kv_cache = (k, v)
+        return q, k, v
+
+    def forward(self, x, rope_cache, position_ids=None):
+        c = self.config
+        b, s, _ = x.shape
+        q, k, v = self._qkv(x, rope_cache, position_ids)
         # heads on mp, batch on (dp, sharding), seq on sep
-        if kv_cache is None and c.context_parallel in ("ring", "ulysses"):
+        if c.context_parallel in ("ring", "ulysses"):
             from ..distributed.context_parallel import \
                 context_parallel_attention
             q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
@@ -167,10 +168,38 @@ class LlamaAttention(Layer):
             k = constrain(k, ("dp", "sharding"), None, "mp", None)
             v = constrain(v, ("dp", "sharding"), None, "mp", None)
             out = flash_attention(q, k, v, causal=True)
-        out = matmul(out.reshape(b, s, -1), self.o_proj)
-        if kv_cache is not None:
-            return out, kv_cache
-        return out
+        return matmul(out.reshape(b, s, -1), self.o_proj)
+
+    def decode(self, x, rope_cache, pos, k_cache, v_cache):
+        """Incremental decode: write this chunk's K/V into the pre-allocated
+        cache at ``pos`` (lax.dynamic_update_slice — static shapes, no
+        concat/recompile) and attend over the whole cache with slots
+        ``> pos+i`` masked.  Decode attention is DMA-bound (q_len ∈
+        {1, prompt}), so it runs the XLA math path by design — the Pallas
+        flash kernel is a training-shape throughput kernel.
+
+        x: (B, s, H*D); k_cache/v_cache: (B, max_len, Hkv, D).
+        Returns (out, k_cache, v_cache).
+        """
+        from .generation import cache_mask
+        from ..ops.attention import flash_attention_reference
+
+        b, s, _ = x.shape
+        position_ids = pos + jnp.arange(s)[None, :]
+        q, k, v = self._qkv(x, rope_cache, position_ids)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+        q = constrain(q, ("dp", "sharding"), None, "mp", None)
+        k_cache = constrain(k_cache, ("dp", "sharding"), None, "mp", None)
+        v_cache = constrain(v_cache, ("dp", "sharding"), None, "mp", None)
+        out = flash_attention_reference(
+            q, k_cache, v_cache, attn_mask=cache_mask(pos, s,
+                                                      k_cache.shape[1]),
+            return_lse=False)
+        return (matmul(out.reshape(b, s, -1), self.o_proj),
+                k_cache, v_cache)
 
 
 class LlamaMLP(Layer):
@@ -217,6 +246,13 @@ class LlamaDecoderLayer(Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return constrain(x, *_batch_spec(x.ndim))
 
+    def decode(self, x, rope_cache, pos, k_cache, v_cache):
+        a, k_cache, v_cache = self.self_attn.decode(
+            self.input_layernorm(x), rope_cache, pos, k_cache, v_cache)
+        x = x + a
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, k_cache, v_cache
+
 
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
@@ -250,6 +286,19 @@ class LlamaModel(Layer):
             else:
                 x = block(x, rope, position_ids)
         return self.norm(x)
+
+    def decode(self, input_ids, cache, pos):
+        """Cache-carrying decode pass.  ``cache``: the stacked
+        (L, 2, B, max_len, Hkv, D) array from
+        :func:`paddle_tpu.models.generation.init_kv_cache`; ``pos`` is the
+        number of tokens already in the cache.  Returns (hidden, cache)."""
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        rope = (self.rope_cos, self.rope_sin)
+        for i, block in enumerate(self.layers):
+            x, k_c, v_c = block.decode(x, rope, pos, cache[i, 0],
+                                       cache[i, 1])
+            cache = cache.at[i, 0].set(k_c).at[i, 1].set(v_c)
+        return self.norm(x), cache
 
 
 def causal_lm_loss(logits, labels):
@@ -291,6 +340,20 @@ class LlamaForCausalLM(Layer):
 
     def compute_loss(self, input_ids, labels, position_ids=None):
         return causal_lm_loss(self.forward(input_ids, position_ids), labels)
+
+    def decode_step(self, input_ids, cache, pos):
+        """(logits, cache): one cache-carrying decode step (prefill when
+        ``input_ids`` is the whole prompt at pos=0, incremental when it is
+        the last token).  See models/generation.py for the cache layout."""
+        hidden, cache = self.model.decode(input_ids, cache, pos)
+        return self.logits(hidden), cache
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kw):
+        """Greedy/sampled generation with the pre-allocated KV cache
+        (parity: PaddleNLP ``model.generate``; see
+        :func:`paddle_tpu.models.generation.greedy_generate`)."""
+        from .generation import greedy_generate
+        return greedy_generate(self, input_ids, max_new_tokens, **kw)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,209 @@
+"""paddle_tpu.static — thin parity facade over the jit/tracing stack.
+
+Parity surface: upstream python/paddle/static/ (~60k LoC: ``Program``,
+``Executor``, ``program_guard``, ``static.data``, ``enable_static``) plus
+the C++ ProgramDesc machinery it drives.  SURVEY §2.2 marks this layer
+design-collapsed: under jax, "static graph mode" is not a mode — EVERY
+jitted function is traced once into a static program (jaxpr → StableHLO)
+and cached.  This module exists so reference users find the names, with
+each name mapped onto the real jax equivalent rather than re-implementing
+graph capture by Python side effects:
+
+  * a :class:`Program` wraps a Python function + input specs; "building"
+    the program is tracing it (``Program.trace``), and ``main_program``
+    shows the jaxpr the way the reference prints a ProgramDesc;
+  * graph construction by side effect (``with program_guard(): x =
+    static.data(...); y = ops(x)``) is the one idiom that cannot map onto
+    functional tracing — :func:`program_guard` therefore collects
+    ``static.data`` declarations and the program body is supplied as a
+    function (``Program.set_body`` or the ``@prog.body`` decorator), which
+    is the same dataflow with the capture made explicit;
+  * :class:`Executor` runs a Program with a feed dict / fetch list like
+    the reference's ``exe.run(prog, feed=..., fetch_list=...)``; the
+    "place" argument is accepted and ignored (device placement belongs to
+    jax.sharding, not the executor);
+  * :func:`enable_static` / :func:`disable_static` keep the mode flag for
+    API compatibility; computation is identical either way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..jit import InputSpec
+from ..utils.logging import VLOG
+
+__all__ = ["Program", "Executor", "program_guard", "data",
+           "default_main_program", "default_startup_program",
+           "enable_static", "disable_static", "in_static_mode",
+           "InputSpec", "CPUPlace", "TPUPlace"]
+
+_static_mode = False
+_current_program: Optional["Program"] = None
+
+
+def enable_static() -> None:
+    """Parity no-op with a flag: jax programs are already traced-static
+    under jit; there is no eager/graph dichotomy to switch."""
+    global _static_mode
+    _static_mode = True
+    VLOG(1, "enable_static(): parity flag only — jit tracing is always "
+            "the 'static graph' path on this backend")
+
+
+def disable_static() -> None:
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+class CPUPlace:
+    """Parity placeholder; devices are owned by jax."""
+
+
+class TPUPlace(CPUPlace):
+    pass
+
+
+class Program:
+    """A traceable computation: body function + declared inputs.
+
+    The reference's Program is a mutable op list built by side effects;
+    here the body is a function and the "op list" is the jaxpr jax traces
+    from it — one artifact, no builder state to corrupt.
+    """
+
+    def __init__(self):
+        self._specs: Dict[str, InputSpec] = {}
+        self._body: Optional[Callable] = None
+        self._jitted = None
+
+    # -- construction --------------------------------------------------------
+
+    def add_input(self, name: str, spec: InputSpec) -> None:
+        self._specs[name] = spec
+
+    def set_body(self, fn: Callable) -> Callable:
+        """``fn(**inputs)`` computes the program outputs (any pytree)."""
+        self._body = fn
+        self._jitted = None
+        return fn
+
+    body = set_body  # decorator alias: @prog.body
+
+    # -- views ---------------------------------------------------------------
+
+    @property
+    def input_names(self) -> List[str]:
+        return list(self._specs)
+
+    def _avals(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        return {
+            name: jax.ShapeDtypeStruct(
+                tuple(1 if d is None else d for d in s.shape), s.dtype)
+            for name, s in self._specs.items()}
+
+    def trace(self):
+        """The traced program (parity: ProgramDesc; here a ClosedJaxpr)."""
+        if self._body is None:
+            raise RuntimeError("Program has no body: call set_body(fn) or "
+                               "use the @prog.body decorator")
+        return jax.make_jaxpr(lambda kw: self._body(**kw))(self._avals())
+
+    @property
+    def main_program(self) -> str:
+        return str(self.trace())
+
+    def __str__(self) -> str:
+        return self.main_program
+
+
+def default_main_program() -> Program:
+    global _current_program
+    if _current_program is None:
+        _current_program = Program()
+    return _current_program
+
+
+def default_startup_program() -> Program:
+    """Parity shim: jax has no separate init program — parameter init is
+    ordinary traced computation — so this returns an empty Program."""
+    return Program()
+
+
+class program_guard:
+    """``with program_guard(prog):`` makes ``prog`` the target of
+    :func:`data` declarations inside the block (parity signature keeps the
+    unused startup_program argument)."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        del startup_program  # no init program on this backend (see above)
+
+    def __enter__(self):
+        global _current_program
+        self._prev = _current_program
+        _current_program = self.main
+        return self.main
+
+    def __exit__(self, *exc):
+        global _current_program
+        _current_program = self._prev
+        return False
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32"):
+    """Declare a program input (parity: paddle.static.data).
+
+    Registers an InputSpec on the current program and returns it.  The
+    returned spec is a declaration, not a tensor — ops consume the real
+    arrays the Executor feeds, inside the program body function.
+    """
+    spec = InputSpec(shape, dtype, name=name)
+    default_main_program().add_input(name, spec)
+    return spec
+
+
+class Executor:
+    """Run Programs with feed/fetch (parity: paddle.static.Executor)."""
+
+    def __init__(self, place: Any = None):
+        del place  # jax owns devices; kept for signature parity
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            return_numpy: bool = True):
+        """Execute ``program`` (default: the current/default one) on a feed
+        dict; returns the body's outputs as a list (parity with the
+        reference's fetched-var list).  ``fetch_list`` selects by index or
+        dict key when the body returns a dict/tuple; None fetches all."""
+        import numpy as np
+
+        prog = program or default_main_program()
+        if prog._body is None:
+            raise RuntimeError("Program has no body to run")
+        feed = {k: jnp.asarray(v) for k, v in (feed or {}).items()}
+        missing = set(prog.input_names) - set(feed)
+        if missing:
+            raise ValueError(f"feed missing program inputs: {sorted(missing)}")
+        if prog._jitted is None:
+            prog._jitted = jax.jit(lambda kw: prog._body(**kw))
+        out = prog._jitted(feed)
+        if isinstance(out, dict):
+            keys = fetch_list if fetch_list is not None else list(out)
+            vals = [out[k] for k in keys]
+        elif isinstance(out, (tuple, list)):
+            vals = list(out)
+            if fetch_list is not None:
+                vals = [vals[i] for i in fetch_list]
+        else:
+            vals = [out]
+        return [np.asarray(v) for v in vals] if return_numpy else vals

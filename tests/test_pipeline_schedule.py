@@ -1,0 +1,105 @@
+"""Pipeline enqueue-order measurement (round-2 verdict weak #4 / task 5).
+
+The simulator models the real executor: one host enqueues globally, each
+stage's sub-mesh runs its ops FIFO, an op starts when its stage is free
+and its deps are done.  These tests pin the measured bubble fractions the
+docstrings claim, assert the orders are valid, and prove the old
+depth-first interleave order really was the head-of-line-blocking problem
+the verdict called out.
+"""
+
+import pytest
+
+from paddle_tpu.distributed.pipeline_schedule import (_deps, schedule_ops,
+                                                      simulate)
+
+
+def _depth_first_ops(S, V, M):
+    """The pre-round-3 enqueue order: each microbatch walks ALL chunks
+    before the next is touched (kept here as the measured baseline)."""
+    C = S * V
+    ops = []
+    warmup = min(C - 1, M)
+    for m in range(warmup):
+        ops += [("fwd", c, m) for c in range(C)]
+    nb = 0
+    for m in range(warmup, M):
+        ops += [("fwd", c, m) for c in range(C)]
+        ops += [("bwd", c, nb) for c in reversed(range(C))]
+        nb += 1
+    while nb < M:
+        ops += [("bwd", c, nb) for c in reversed(range(C))]
+        nb += 1
+    return ops
+
+
+def _check_valid(ops, S, V, M):
+    """Complete + topologically ordered."""
+    C = S * V
+    assert len(ops) == 2 * C * M
+    assert len(set(ops)) == len(ops)
+    seen = set()
+    for op in ops:
+        for d in _deps(op, C):
+            assert d in seen, f"{op} enqueued before its dep {d}"
+        seen.add(op)
+
+
+@pytest.mark.parametrize("S,V,M", [(2, 1, 8), (2, 2, 8), (4, 1, 8),
+                                   (4, 2, 16), (2, 4, 8)])
+def test_orders_are_valid(S, V, M):
+    _check_valid(schedule_ops(S, V, M, "1F1B"), S, V, M)
+    if V == 1:
+        _check_valid(schedule_ops(S, V, M, "FThenB"), S, V, M)
+
+
+def test_measured_bubbles_match_docstring_claims():
+    """The exact numbers cited in pipeline.py / pipeline_schedule.py."""
+    S, M = 2, 8
+    b_fthenb = simulate(schedule_ops(S, 1, M, "FThenB"), S)["bubble"]
+    b_1f1b = simulate(schedule_ops(S, 1, M, "1F1B"), S)["bubble"]
+    b_v2 = simulate(schedule_ops(S, 2, M, "1F1B"), S)["bubble"]
+    b_df_v2 = simulate(_depth_first_ops(S, 2, M), S)["bubble"]
+
+    assert b_1f1b == pytest.approx(1 / 9, abs=1e-3)        # (S-1)/(M+S-1)
+    assert b_fthenb == pytest.approx(1 / 9, abs=1e-3)      # same bubble...
+    assert b_v2 == pytest.approx(1 / 17, abs=1e-3)         # (S-1)/(VM+S-1)
+    assert b_v2 < b_1f1b                                    # interleave wins
+    assert b_df_v2 > 7 * b_v2                               # old order: 7.6x
+
+
+def test_interleave_beats_v1_at_depth_4():
+    M = 8
+    b_v1 = simulate(schedule_ops(4, 1, M, "1F1B"), 4)["bubble"]
+    b_v2 = simulate(schedule_ops(4, 2, M, "1F1B"), 4)["bubble"]
+    assert b_v1 == pytest.approx(3 / 11, abs=1e-3)
+    assert b_v2 < b_v1
+
+
+def test_1f1b_memory_profile_bounded():
+    """1F1B's reason to exist vs FThenB: in-flight microbatches ≤ S·V, not
+    M.  Count the worst case over the enqueue order."""
+    for (S, V, M) in [(2, 1, 16), (2, 2, 16), (4, 1, 16)]:
+        inflight = peak = 0
+        for kind, c, m in schedule_ops(S, V, M, "1F1B"):
+            if kind == "fwd" and c == 0:
+                inflight += 1
+                peak = max(peak, inflight)
+            if kind == "bwd" and c == 0:
+                inflight -= 1
+        assert peak <= S * V, f"S={S} V={V}: peak in-flight {peak}"
+        # FThenB holds all M
+        peak_f = inflight = 0
+        if V == 1:
+            for kind, c, m in schedule_ops(S, V, M, "FThenB"):
+                if kind == "fwd" and c == 0:
+                    inflight += 1
+                    peak_f = max(peak_f, inflight)
+                if kind == "bwd" and c == 0:
+                    inflight -= 1
+            assert peak_f == M
+
+
+def test_simulate_rejects_non_topological_order():
+    with pytest.raises(AssertionError, match="deadlock"):
+        simulate([("bwd", 0, 0), ("fwd", 0, 0)], 1)

@@ -1,0 +1,105 @@
+"""Pallas rms_norm kernel: interpret-mode oracle + grad-path checks.
+
+Pattern: the reference's fused_rms_norm op tests
+(test/legacy_test/test_fused_rms_norm_op.py, upstream layout) — NumPy
+oracle on the forward, and the hybrid custom_vjp (Pallas fwd / XLA bwd)
+checked against jax.grad of the pure XLA path.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.ops.norms import rms_norm, rms_norm_reference
+from paddle_tpu.ops.pallas.rms_norm import rms_norm_pallas
+
+
+def np_rms_norm(x, weight=None, eps=1e-6):
+    xf = np.asarray(x, np.float64)
+    y = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + eps)
+    if weight is not None:
+        y = y * np.asarray(weight, np.float64)
+    return y
+
+
+@pytest.mark.parametrize("shape", [(8, 256), (2, 8, 512), (16, 1024)])
+@pytest.mark.parametrize("with_weight", [True, False])
+def test_kernel_matches_oracle(shape, with_weight):
+    rng = np.random.RandomState(0)
+    x = rng.randn(*shape).astype(np.float32)
+    w = rng.randn(shape[-1]).astype(np.float32) if with_weight else None
+    out = rms_norm_pallas(jnp.asarray(x),
+                          None if w is None else jnp.asarray(w),
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np_rms_norm(x, w),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_bf16():
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 512).astype(np.float32)
+    out = rms_norm_pallas(jnp.asarray(x, jnp.bfloat16), interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np_rms_norm(x), rtol=3e-2, atol=3e-2)
+
+
+def test_kernel_shape_eligibility():
+    with pytest.raises(NotImplementedError, match="last dim"):
+        rms_norm_pallas(jnp.zeros((8, 100)), interpret=True)
+    with pytest.raises(NotImplementedError, match="row count"):
+        rms_norm_pallas(jnp.zeros((3, 256)), interpret=True)
+
+
+def test_dispatcher_routes_long_rows_and_grads_match(monkeypatch):
+    """Long rows go through the Pallas forward; the custom_vjp backward
+    must equal jax.grad of the pure XLA path."""
+    flags.set_flags({"pallas_interpret": True,
+                     "rms_norm_pallas_min_dim": 256})
+    try:
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(8, 512).astype(np.float32))
+        w = jnp.asarray(rng.randn(512).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(rms_norm(x, w)),
+                                   np_rms_norm(np.asarray(x), np.asarray(w)),
+                                   rtol=2e-5, atol=2e-5)
+
+        def loss_pallas(x, w):
+            return jnp.sum(rms_norm(x, w) ** 2)
+
+        def loss_ref(x, w):
+            return jnp.sum(rms_norm_reference(x, w) ** 2)
+
+        gx_p, gw_p = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+        gx_r, gw_r = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx_p), np.asarray(gx_r),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gw_p), np.asarray(gw_r),
+                                   rtol=1e-4, atol=1e-4)
+
+        # no-weight grad path
+        gx_p2 = jax.grad(lambda x: jnp.sum(rms_norm(x) ** 2))(x)
+        gx_r2 = jax.grad(lambda x: jnp.sum(rms_norm_reference(x) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(gx_p2), np.asarray(gx_r2),
+                                   rtol=1e-4, atol=1e-4)
+    finally:
+        flags.set_flags({"pallas_interpret": False,
+                         "rms_norm_pallas_min_dim": 4096})
+
+
+def test_dispatcher_short_rows_take_xla_path(monkeypatch):
+    """Rows below the threshold must NOT invoke the Pallas kernel."""
+    import paddle_tpu.ops.norms as norms
+
+    def boom(*a, **k):
+        raise AssertionError("Pallas kernel called for short rows")
+
+    monkeypatch.setattr(norms, "_rms_pallas_diffable", boom)
+    flags.set_flags({"pallas_interpret": True})
+    try:
+        out = rms_norm(jnp.ones((8, 128)))
+        assert np.all(np.isfinite(np.asarray(out)))
+    finally:
+        flags.set_flags({"pallas_interpret": False})

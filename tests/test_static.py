@@ -1,0 +1,70 @@
+"""static.Program/Executor parity facade (SURVEY §2.2 Static API row).
+
+Pattern: the reference's program_guard + exe.run smoke tests
+(test/legacy_test/test_executor_*.py, upstream layout), adapted to the
+function-body form this backend documents (graph capture by side effect is
+replaced by explicit function tracing — see paddle_tpu/static/__init__.py).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import static
+
+
+def test_program_guard_data_and_run():
+    prog = static.Program()
+    with static.program_guard(prog):
+        static.data("x", [None, 4], "float32")
+        static.data("w", [4, 2], "float32")
+
+    @prog.body
+    def _(x, w):
+        return {"y": jnp.tanh(x @ w), "s": jnp.sum(x)}
+
+    exe = static.Executor(static.TPUPlace())
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype(np.float32)
+    w = rng.randn(4, 2).astype(np.float32)
+    y, s = exe.run(prog, feed={"x": x, "w": w}, fetch_list=["y", "s"])
+    np.testing.assert_allclose(y, np.tanh(x @ w), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(s, x.sum(), rtol=1e-5)
+
+
+def test_executor_validates_feed_and_body():
+    prog = static.Program()
+    with static.program_guard(prog):
+        static.data("x", [2, 2], "float32")
+    exe = static.Executor()
+    with pytest.raises(RuntimeError, match="no body"):
+        exe.run(prog, feed={"x": np.zeros((2, 2), np.float32)})
+    prog.set_body(lambda x: x + 1)
+    with pytest.raises(ValueError, match="missing program inputs"):
+        exe.run(prog, feed={})
+    (out,) = exe.run(prog, feed={"x": np.ones((2, 2), np.float32)})
+    np.testing.assert_allclose(out, 2.0)
+
+
+def test_main_program_shows_jaxpr():
+    prog = static.Program()
+    with static.program_guard(prog):
+        static.data("x", [None, 3], "float32")
+    prog.set_body(lambda x: jnp.exp(x) * 2.0)
+    text = prog.main_program
+    assert "exp" in text and "mul" in text  # the traced op list, ProgramDesc-style
+
+
+def test_static_mode_flags_and_default_program():
+    assert not static.in_static_mode()
+    static.enable_static()
+    try:
+        assert static.in_static_mode()
+    finally:
+        static.disable_static()
+    assert not static.in_static_mode()
+    p1 = static.default_main_program()
+    assert p1 is static.default_main_program()  # singleton
+    assert static.default_startup_program() is not p1
+    assert pt.static is static  # exported at package top
