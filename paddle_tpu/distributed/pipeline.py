@@ -331,9 +331,11 @@ class PipelineParallel:
             if c > 0:
                 cot[m] = dx
 
-        self.last_ops = schedule_ops(self.layers.num_stages,
-                                     self.layers.num_virtual_stages, M,
-                                     self.schedule)
+        # copy: schedule_ops is lru_cached and last_ops is advertised to
+        # external consumers — aliasing would let them corrupt the cache
+        self.last_ops = list(schedule_ops(self.layers.num_stages,
+                                          self.layers.num_virtual_stages, M,
+                                          self.schedule))
         for kind, c, m in self.last_ops:
             (fwd_op if kind == "fwd" else bwd_op)(c, m)
 

@@ -79,7 +79,12 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
         raise ValueError(
             f"prompt + max_new_tokens = {total} exceeds the model's "
             f"max_position_embeddings ({limit})")
-    cache = init_kv_cache(model.config, b, total)
+    # attention models carry the stacked KV cache; recurrent models
+    # (Mamba/RWKV) provide their own O(1) state pytree instead
+    if hasattr(model, "init_decode_state"):
+        cache = model.init_decode_state(b, total)
+    else:
+        cache = init_kv_cache(model.config, b, total)
     params = model.state_dict(include_buffers=True)
 
     def pick(logits, key):
@@ -92,9 +97,19 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-    # NOTE: jitted per generate() call (the model closure is rebound);
-    # inside the jit the whole loop is ONE compiled scan — no per-token
-    # dispatch, no per-step recompilation.
+    # one compiled scan per static generation config, cached on the model:
+    # repeat generate() calls with the same shapes/settings (the serving
+    # pattern) reuse the jitted program instead of re-tracing every call
+    cache_key = (b, s, total, max_new_tokens, eos_token_id, pad_token_id,
+                 temperature, top_k)
+    gen_cache = getattr(model, "_generate_jit_cache", None)
+    if gen_cache is None:
+        gen_cache = model._generate_jit_cache = {}
+    if cache_key in gen_cache:
+        out = gen_cache[cache_key](params, input_ids, cache,
+                                   jax.random.key(seed))
+        return jnp.concatenate([input_ids, out], axis=1)
+
     @jax.jit
     def run(params, input_ids, cache, key):
         with bind_params(model, params):
@@ -123,6 +138,7 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
             # is the last generated one → exactly max_new_tokens total
             return jnp.concatenate([toks.T, carry[2][:, None]], axis=1)
 
+    gen_cache[cache_key] = run
     out = run(params, input_ids, cache, jax.random.key(seed))
     return jnp.concatenate([input_ids, out], axis=1)
 

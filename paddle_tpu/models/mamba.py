@@ -111,13 +111,37 @@ class Mamba2Mixer(Layer):
         return out + self.conv_b
 
     def forward(self, x):
+        y = self._mix(x, conv_state=None, ssm_state=None)[0]
+        return y
+
+    def decode(self, x, conv_state, ssm_state):
+        """Recurrent step(s): O(1) state instead of a KV cache — the whole
+        point of the architecture at inference (the reference's
+        selective_state_update path).  conv_state: (B, K-1, conv_dim)
+        rolling window of pre-activation xBC rows; ssm_state: (B, H, P, N).
+        Handles both prefill (L = prompt) and single-token steps."""
+        return self._mix(x, conv_state, ssm_state)
+
+    def _mix(self, x, conv_state, ssm_state):
         c = self.config
         bsz, L, _ = x.shape
         d_in, g_n, H = c.d_inner, c.num_groups * c.state_size, c.num_heads
         proj = matmul(x, self.in_proj)
         z, xbc, dt = jnp.split(proj, [d_in, 2 * d_in + 2 * g_n], axis=-1)
-        xbc = F.silu(self._causal_dw_conv(xbc))
-        xs, b, cc = jnp.split(xbc, [d_in, d_in + g_n], axis=-1)
+        if conv_state is None:
+            xbc_conv = self._causal_dw_conv(xbc)
+            new_conv = None
+        else:
+            window = jnp.concatenate([conv_state.astype(xbc.dtype), xbc],
+                                     axis=1)          # (B, K-1+L, conv_dim)
+            k = c.conv_kernel
+            out = jnp.zeros_like(xbc)
+            for i in range(k):
+                out = out + window[:, i:i + L] * self.conv_w[i]
+            xbc_conv = out + self.conv_b
+            new_conv = window[:, -(k - 1):]
+        xbc_conv = F.silu(xbc_conv)
+        xs, b, cc = jnp.split(xbc_conv, [d_in, d_in + g_n], axis=-1)
 
         dt = jax.nn.softplus(dt.astype(jnp.float32)
                              + self.dt_bias)              # (B, L, H)
@@ -129,11 +153,12 @@ class Mamba2Mixer(Layer):
         bg = b.reshape(bsz, L, c.num_groups, c.state_size).astype(jnp.float32)
         cg = cc.reshape(bsz, L, c.num_groups,
                         c.state_size).astype(jnp.float32)
-        y, _ = ssd_scan(x_in, a, bg, cg, chunk=min(c.chunk_size, L))
+        y, new_ssm = ssd_scan(x_in, a, bg, cg, h0=ssm_state,
+                              chunk=min(c.chunk_size, L))
         y = y + self.D[None, None, :, None] * xh.astype(jnp.float32)
         y = y.reshape(bsz, L, d_in).astype(x.dtype)
         y = self.norm(y * F.silu(z))
-        return matmul(y, self.out_proj)
+        return matmul(y, self.out_proj), new_conv, new_ssm
 
 
 class Mamba2Block(Layer):
@@ -145,6 +170,11 @@ class Mamba2Block(Layer):
 
     def forward(self, x):
         return x + self.mixer(self.norm(x))
+
+    def decode(self, x, conv_state, ssm_state):
+        y, conv_state, ssm_state = self.mixer.decode(self.norm(x),
+                                                     conv_state, ssm_state)
+        return x + y, conv_state, ssm_state
 
 
 class Mamba2ForCausalLM(Layer):
@@ -178,3 +208,36 @@ class Mamba2ForCausalLM(Layer):
 
     def compute_loss(self, input_ids, labels):
         return causal_lm_loss(self.forward(input_ids), labels)
+
+    # -- O(1)-state decode ----------------------------------------------------
+
+    def init_decode_state(self, batch_size: int, max_length: int):
+        """Recurrent decode state: constant in max_length (the SSM carries
+        the whole history in (H, P, N) + a (K-1)-row conv window) — the
+        architecture's selling point vs the attention models' O(L) cache."""
+        del max_length
+        c = self.config
+        conv_dim = c.d_inner + 2 * c.num_groups * c.state_size
+        return {
+            "conv": jnp.zeros((c.num_hidden_layers, batch_size,
+                               c.conv_kernel - 1, conv_dim), c.dtype),
+            "ssm": jnp.zeros((c.num_hidden_layers, batch_size, c.num_heads,
+                              c.head_dim, c.state_size), jnp.float32),
+        }
+
+    def decode_step(self, input_ids, state, pos):
+        """(logits, state); ``pos`` is unused (no positional encoding) but
+        kept for the shared generation-loop signature."""
+        del pos
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        conv, ssm = state["conv"], state["ssm"]
+        for i, blk in enumerate(self.layers):
+            x, c_i, s_i = blk.decode(x, conv[i], ssm[i])
+            conv = conv.at[i].set(c_i.astype(conv.dtype))
+            ssm = ssm.at[i].set(s_i)
+        return (matmul(self.norm_f(x), self.lm_head),
+                {"conv": conv, "ssm": ssm})
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kw):
+        from .generation import greedy_generate
+        return greedy_generate(self, input_ids, max_new_tokens, **kw)

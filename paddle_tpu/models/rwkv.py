@@ -23,7 +23,7 @@ from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.common import LayerNorm
 from ..nn.layer import Layer, LayerList
-from ..ops.rwkv import wkv
+from ..ops.rwkv import wkv, wkv_init_state, wkv_with_state
 from ..tensor.math import matmul
 from .llama import _batch_spec, causal_lm_loss
 
@@ -79,14 +79,28 @@ class RwkvTimeMix(Layer):
 
     def forward(self, x):
         xx = _token_shift(x)
+        return self._mix(x, xx)[0]
+
+    def _mix(self, x, xx, pqo=None):
         xk = x * self.mix_k + xx * (1 - self.mix_k)
         xv = x * self.mix_v + xx * (1 - self.mix_v)
         xr = x * self.mix_r + xx * (1 - self.mix_r)
         r = F.sigmoid(matmul(xr, self.receptance))
         k = matmul(xk, self.key)
         v = matmul(xv, self.value)
-        mixed = wkv(self.time_decay, self.time_first, k, v).astype(x.dtype)
-        return matmul(r * mixed, self.output)
+        if pqo is None:
+            pqo = wkv_init_state(k.shape[0], k.shape[-1])
+        mixed, pqo = wkv_with_state(self.time_decay, self.time_first, k, v,
+                                    pqo)
+        return matmul(r * mixed.astype(x.dtype), self.output), pqo
+
+    def decode(self, x, prev_x, pqo):
+        """O(1)-state step(s): token shift seeded by the last token of the
+        previous chunk; wkv state carried (p, q, o)."""
+        xx = jnp.concatenate([prev_x[:, None].astype(x.dtype), x[:, :-1]],
+                             axis=1)
+        out, pqo = self._mix(x, xx, pqo)
+        return out, x[:, -1], pqo
 
 
 class RwkvChannelMix(Layer):
@@ -112,11 +126,18 @@ class RwkvChannelMix(Layer):
                                                 attr_name="receptance")
 
     def forward(self, x):
-        xx = _token_shift(x)
+        return self._mix(x, _token_shift(x))
+
+    def _mix(self, x, xx):
         xk = x * self.mix_k + xx * (1 - self.mix_k)
         xr = x * self.mix_r + xx * (1 - self.mix_r)
         k = jnp.square(F.relu(matmul(xk, self.key)))
         return F.sigmoid(matmul(xr, self.receptance)) * matmul(k, self.value)
+
+    def decode(self, x, prev_x):
+        xx = jnp.concatenate([prev_x[:, None].astype(x.dtype), x[:, :-1]],
+                             axis=1)
+        return self._mix(x, xx), x[:, -1]
 
 
 class RwkvBlock(Layer):
@@ -132,6 +153,15 @@ class RwkvBlock(Layer):
     def forward(self, x):
         x = x + self.attention(self.ln1(x))
         return x + self.feed_forward(self.ln2(x))
+
+    def decode(self, x, st):
+        """st: dict with att_x (B,C), p/q/o (B,C), ffn_x (B,C)."""
+        a, att_x, (p, q, o) = self.attention.decode(
+            self.ln1(x), st["att_x"], (st["p"], st["q"], st["o"]))
+        x = x + a
+        f, ffn_x = self.feed_forward.decode(self.ln2(x), st["ffn_x"])
+        return x + f, {"att_x": att_x, "p": p, "q": q, "o": o,
+                       "ffn_x": ffn_x}
 
 
 class RwkvForCausalLM(Layer):
@@ -168,3 +198,31 @@ class RwkvForCausalLM(Layer):
 
     def compute_loss(self, input_ids, labels):
         return causal_lm_loss(self.forward(input_ids), labels)
+
+    # -- O(1)-state decode ----------------------------------------------------
+
+    def init_decode_state(self, batch_size: int, max_length: int):
+        """Constant-size recurrence state per layer: token-shift partners
+        (att_x/ffn_x) + the stabilised wkv accumulator (p, q, o) — the
+        RNN family's O(1) decode, no KV cache."""
+        del max_length
+        c = self.config
+        z = jnp.zeros((c.num_hidden_layers, batch_size, c.hidden_size),
+                      jnp.float32)
+        return {"att_x": z, "ffn_x": z, "p": z, "q": z,
+                "o": jnp.full_like(z, -1e38)}
+
+    def decode_step(self, input_ids, state, pos):
+        del pos  # no positional encoding in the RNN family
+        x = vocab_parallel_lookup(self.embeddings, input_ids)
+        x = self.ln_pre(x)
+        new = {k: v for k, v in state.items()}
+        for i, blk in enumerate(self.blocks):
+            x, st_i = blk.decode(x, {k: state[k][i] for k in state})
+            for k in new:
+                new[k] = new[k].at[i].set(st_i[k].astype(new[k].dtype))
+        return matmul(self.ln_out(x), self.head), new
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kw):
+        from .generation import greedy_generate
+        return greedy_generate(self, input_ids, max_new_tokens, **kw)

@@ -24,26 +24,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["wkv", "wkv_reference"]
+__all__ = ["wkv", "wkv_with_state", "wkv_reference"]
 
 
-def wkv(w, u, k, v):
-    """RWKV linear-attention mix.
+def wkv_with_state(w, u, k, v, state):
+    """:func:`wkv` with an explicit carried recurrence state — the O(1)
+    incremental-decode form (the reference kernel's ``aa/bb/pp`` state).
 
-    Args:
-      w: (C,) channel decay rates, >= 0 (applied as e^{-w} per step).
-      u: (C,) first-token bonus.
-      k, v: (B, L, C) keys / values.
-    Returns: (B, L, C) mixed values, fp32.
+    ``state``: (p, q, o) each (B, C) fp32 — exp-weighted numerator,
+    denominator, and their shared running max exponent.
+    Returns (out (B, L, C) fp32, new_state).
     """
     w = -jnp.asarray(w, jnp.float32)       # per-step log-decay (<= 0)
     u = jnp.asarray(u, jnp.float32)
     k = jnp.asarray(k, jnp.float32)
     v = jnp.asarray(v, jnp.float32)
-    B, L, C = k.shape
 
-    def step(state, kv_t):
-        p, q, o = state                     # (B, C) each
+    def step(st, kv_t):
+        p, q, o = st                        # (B, C) each
         k_t, v_t = kv_t
         # output at t: include the bonus term e^{u + k_t} v_t
         no = jnp.maximum(o, u + k_t)
@@ -56,11 +54,29 @@ def wkv(w, u, k, v):
         b2 = jnp.exp(k_t - no2)
         return (a2 * p + b2 * v_t, a2 * q + b2, no2), out
 
-    init = (jnp.zeros((B, C), jnp.float32), jnp.zeros((B, C), jnp.float32),
-            jnp.full((B, C), -1e38, jnp.float32))
-    _, out = lax.scan(step, init, (jnp.moveaxis(k, 1, 0),
-                                   jnp.moveaxis(v, 1, 0)))
-    return jnp.moveaxis(out, 0, 1)
+    final, out = lax.scan(step, state, (jnp.moveaxis(k, 1, 0),
+                                        jnp.moveaxis(v, 1, 0)))
+    return jnp.moveaxis(out, 0, 1), final
+
+
+def wkv_init_state(batch: int, channels: int):
+    """The empty-history state (p = q = 0, running max at -inf)."""
+    return (jnp.zeros((batch, channels), jnp.float32),
+            jnp.zeros((batch, channels), jnp.float32),
+            jnp.full((batch, channels), -1e38, jnp.float32))
+
+
+def wkv(w, u, k, v):
+    """RWKV linear-attention mix.
+
+    Args:
+      w: (C,) channel decay rates, >= 0 (applied as e^{-w} per step).
+      u: (C,) first-token bonus.
+      k, v: (B, L, C) keys / values.
+    Returns: (B, L, C) mixed values, fp32.
+    """
+    B, _, C = k.shape
+    return wkv_with_state(w, u, k, v, wkv_init_state(B, C))[0]
 
 
 def wkv_reference(w, u, k, v):

@@ -63,12 +63,20 @@ def ssd_scan(x, a, b, c, h0=None, chunk: int = 64
     bsz, L, h, p = x.shape
     g, n = b.shape[2], b.shape[3]
     rep = h // g
+    if L < chunk:
+        chunk = L
     if L % chunk:
-        if L < chunk:
-            chunk = L
-        else:
-            raise ValueError(f"seq len {L} not divisible by chunk {chunk}")
-    nc = L // chunk
+        # pad the tail up to a chunk multiple with identity steps
+        # (a=1 keeps the state, x=b=0 contribute nothing, c=0 reads
+        # nothing); padded outputs are sliced off at the end — so any L
+        # runs at full chunk width instead of degrading the chunk size
+        pad = chunk - L % chunk
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        a = jnp.pad(a, ((0, 0), (0, pad), (0, 0)), constant_values=1.0)
+        b = jnp.pad(b, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        c = jnp.pad(c, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    Lp = x.shape[1]
+    nc = Lp // chunk
 
     xf = x.astype(jnp.float32).reshape(bsz, nc, chunk, h, p)
     af = a.astype(jnp.float32).reshape(bsz, nc, chunk, h)
@@ -112,5 +120,5 @@ def ssd_scan(x, a, b, c, h0=None, chunk: int = 64
     # inter-chunk: y[i] += c_i · (decay-to-i * h_prev_chunk)
     y_inter = jnp.einsum("bkihn,bkih,bkhpn->bkihp",
                          cf, jnp.exp(la), hprevs)
-    y = (y_intra + y_inter).reshape(bsz, L, h, p).astype(x.dtype)
+    y = (y_intra + y_inter).reshape(bsz, Lp, h, p)[:, :L].astype(x.dtype)
     return y, hlast

@@ -170,3 +170,76 @@ def test_generate_rejects_past_rope_cache(lm):
     # tiny config: max_position_embeddings=128
     with pytest.raises(ValueError, match="max_position_embeddings"):
         lm.generate(_prompt(1, 120), max_new_tokens=20)
+
+
+@pytest.mark.parametrize("family", ["mamba", "rwkv"])
+def test_recurrent_decode_matches_full_forward(family):
+    """Mamba-2 / RWKV carry O(1) recurrence state instead of a KV cache;
+    the same gold-standard property must hold: greedy cached decode ==
+    full-forward argmax at every position."""
+    if family == "mamba":
+        from paddle_tpu.models.mamba import (Mamba2ForCausalLM,
+                                             tiny_mamba2_config)
+        pt.seed(31)
+        model = Mamba2ForCausalLM(tiny_mamba2_config())
+    else:
+        from paddle_tpu.models.rwkv import RwkvForCausalLM, tiny_rwkv_config
+        pt.seed(33)
+        model = RwkvForCausalLM(tiny_rwkv_config())
+    model.eval()
+    ids = _prompt(2, 6, seed=37)
+
+    # prefill logits == full forward on the prompt
+    state = model.init_decode_state(2, 16)
+    logits, state = model.decode_step(ids, state, jnp.int32(0))
+    full = model(ids)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                               rtol=2e-3, atol=2e-3,
+                               err_msg=f"{family} prefill != forward")
+
+    n_new = 5
+    out = np.asarray(model.generate(ids, max_new_tokens=n_new))
+    assert out.shape == (2, 6 + n_new)
+    for t in range(n_new):
+        prefix = jnp.asarray(out[:, :6 + t], jnp.int32)
+        want = np.asarray(jnp.argmax(model(prefix)[:, -1], axis=-1))
+        np.testing.assert_array_equal(
+            out[:, 6 + t], want,
+            err_msg=f"{family} greedy token {t} != full-forward argmax")
+
+
+def test_generate_reuses_compiled_program(lm):
+    """Repeat generate() with identical shapes/settings must not re-trace."""
+    import time
+
+    lm._generate_jit_cache = {}
+    ids = _prompt(2, 6, seed=41)
+    t0 = time.perf_counter()
+    a = lm.generate(ids, max_new_tokens=4)
+    first = time.perf_counter() - t0
+    assert len(lm._generate_jit_cache) == 1
+    t0 = time.perf_counter()
+    b = lm.generate(ids, max_new_tokens=4)
+    second = time.perf_counter() - t0
+    assert len(lm._generate_jit_cache) == 1  # hit, no new entry
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert second < first / 2, (first, second)  # no re-trace/compile
+
+
+def test_ssd_scan_pads_non_divisible_lengths():
+    """ssd_scan must handle L % chunk != 0 at full chunk width (padding
+    with identity steps), matching the sequential oracle and final state."""
+    from paddle_tpu.ops.ssd import ssd_scan, ssd_scan_reference
+
+    rng = np.random.RandomState(51)
+    B, L, H, P, G, N = 2, 13, 4, 8, 2, 6
+    x = jnp.asarray(rng.randn(B, L, H, P).astype(np.float32))
+    a = jnp.asarray(rng.uniform(0.5, 0.99, (B, L, H)).astype(np.float32))
+    bb = jnp.asarray(rng.randn(B, L, G, N).astype(np.float32))
+    cc = jnp.asarray(rng.randn(B, L, G, N).astype(np.float32))
+    y, h = ssd_scan(x, a, bb, cc, chunk=4)        # 13 % 4 != 0 → padded
+    y_ref, h_ref = ssd_scan_reference(x, a, bb, cc)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref),
+                               rtol=2e-4, atol=2e-4)
