@@ -55,7 +55,8 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
                     temperature: float = 0.0,
                     top_k: Optional[int] = None,
                     seed: int = 0,
-                    max_length: Optional[int] = None):
+                    max_length: Optional[int] = None,
+                    extra_inputs: Optional[dict] = None):
     """Generate ``max_new_tokens`` continuations for a batch of prompts.
 
     ``model`` must expose ``decode_step(input_ids, cache, pos) ->
@@ -63,6 +64,11 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
     (the parity-tested path); ``temperature > 0`` samples, optionally
     top-k-truncated.  Returns int32 (batch, prompt_len + max_new_tokens);
     rows that hit ``eos_token_id`` are padded with ``pad_token_id``.
+
+    ``extra_inputs``: dict of arrays forwarded to every ``decode_step``
+    call as keyword arguments (e.g. a VLM's precomputed vision features) —
+    they are real jit inputs, not baked constants, so the compiled program
+    is reused across prompts AND images.
     """
     from ..nn.layer import bind_params
 
@@ -97,24 +103,27 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
+    extra = extra_inputs or {}
     # one compiled scan per static generation config, cached on the model:
     # repeat generate() calls with the same shapes/settings (the serving
     # pattern) reuse the jitted program instead of re-tracing every call
     cache_key = (b, s, total, max_new_tokens, eos_token_id, pad_token_id,
-                 temperature, top_k)
+                 temperature, top_k,
+                 tuple(sorted((k, v.shape) for k, v in extra.items())))
     gen_cache = getattr(model, "_generate_jit_cache", None)
     if gen_cache is None:
         gen_cache = model._generate_jit_cache = {}
     if cache_key in gen_cache:
         out = gen_cache[cache_key](params, input_ids, cache,
-                                   jax.random.key(seed))
+                                   jax.random.key(seed), extra)
         return jnp.concatenate([input_ids, out], axis=1)
 
     @jax.jit
-    def run(params, input_ids, cache, key):
+    def run(params, input_ids, cache, key, extra):
         with bind_params(model, params):
             # prefill: one pass over the whole prompt
-            logits, cache = model.decode_step(input_ids, cache, jnp.int32(0))
+            logits, cache = model.decode_step(input_ids, cache,
+                                              jnp.int32(0), **extra)
             key, sub = jax.random.split(key)
             nxt = pick(logits[:, -1], sub)
             done = jnp.zeros((b,), bool)
@@ -123,7 +132,8 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
 
             def step(carry, _):
                 cache, pos, tok, done, key = carry
-                logits, cache = model.decode_step(tok[:, None], cache, pos)
+                logits, cache = model.decode_step(tok[:, None], cache, pos,
+                                                  **extra)
                 key, sub = jax.random.split(key)
                 new = pick(logits[:, -1], sub)
                 if eos_token_id is not None:
@@ -139,7 +149,7 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
             return jnp.concatenate([toks.T, carry[2][:, None]], axis=1)
 
     gen_cache[cache_key] = run
-    out = run(params, input_ids, cache, jax.random.key(seed))
+    out = run(params, input_ids, cache, jax.random.key(seed), extra)
     return jnp.concatenate([input_ids, out], axis=1)
 
 

@@ -139,7 +139,9 @@ class Mamba2Mixer(Layer):
             for i in range(k):
                 out = out + window[:, i:i + L] * self.conv_w[i]
             xbc_conv = out + self.conv_b
-            new_conv = window[:, -(k - 1):]
+            # NOT window[:, -(k-1):] — for k == 1 that is [:, -0:] == the
+            # whole window instead of the empty state
+            new_conv = window[:, window.shape[1] - (k - 1):]
         xbc_conv = F.silu(xbc_conv)
         xs, b, cc = jnp.split(xbc_conv, [d_in, d_in + g_n], axis=-1)
 

@@ -70,6 +70,10 @@ class Qwen2VLConfig:
     def num_patches(self) -> int:
         return (self.image_size // self.patch_size) ** 2
 
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
     def as_llama(self) -> LlamaConfig:
         return LlamaConfig(
             vocab_size=self.vocab_size, hidden_size=self.hidden_size,
@@ -257,3 +261,29 @@ class Qwen2VLForConditionalGeneration(Layer):
                      position_ids=None):
         logits = self.forward(input_ids, pixel_values, position_ids)
         return causal_lm_loss(logits, labels)
+
+    # -- cached decode --------------------------------------------------------
+
+    def decode_step(self, input_ids, cache, pos, vision):
+        """(logits, cache).  ``vision``: precomputed tower features — they
+        are position-free and fixed for the whole generation, so the cross
+        layers just re-attend the new tokens to them each step (q_len ∈
+        {1, prompt}); only self-attention carries the stacked KV cache."""
+        x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        rope = (self.rope_cos, self.rope_sin)
+        for i, blk in enumerate(self.layers):
+            x, k_c, v_c = blk.decode(x, rope, pos, cache[i, 0], cache[i, 1])
+            cache = cache.at[i, 0].set(k_c).at[i, 1].set(v_c)
+            if i in self._cross_at:
+                x = self._cross_layer(i)(x, vision)
+        return matmul(self.norm(x), self.lm_head), cache
+
+    def generate(self, input_ids, pixel_values, max_new_tokens: int = 32,
+                 **kw):
+        """Greedy/sampled generation conditioned on an image: the vision
+        tower runs ONCE per call; its features ride the decode loop as a
+        jit input (compiled program reused across prompts and images)."""
+        from .generation import greedy_generate
+        vision = self.visual(pixel_values)
+        return greedy_generate(self, input_ids, max_new_tokens,
+                               extra_inputs={"vision": vision}, **kw)

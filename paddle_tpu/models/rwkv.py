@@ -51,6 +51,12 @@ def _token_shift(x):
     return jnp.pad(x, ((0, 0), (1, 0), (0, 0)))[:, :-1]
 
 
+def _token_shift_with_state(x, prev_x):
+    """x_{t-1} seeded by the last token of the previous chunk (decode)."""
+    return jnp.concatenate([prev_x[:, None].astype(x.dtype), x[:, :-1]],
+                           axis=1)
+
+
 class RwkvTimeMix(Layer):
     def __init__(self, c: RwkvConfig, layer_idx: int):
         super().__init__()
@@ -97,9 +103,7 @@ class RwkvTimeMix(Layer):
     def decode(self, x, prev_x, pqo):
         """O(1)-state step(s): token shift seeded by the last token of the
         previous chunk; wkv state carried (p, q, o)."""
-        xx = jnp.concatenate([prev_x[:, None].astype(x.dtype), x[:, :-1]],
-                             axis=1)
-        out, pqo = self._mix(x, xx, pqo)
+        out, pqo = self._mix(x, _token_shift_with_state(x, prev_x), pqo)
         return out, x[:, -1], pqo
 
 
@@ -135,9 +139,7 @@ class RwkvChannelMix(Layer):
         return F.sigmoid(matmul(xr, self.receptance)) * matmul(k, self.value)
 
     def decode(self, x, prev_x):
-        xx = jnp.concatenate([prev_x[:, None].astype(x.dtype), x[:, :-1]],
-                             axis=1)
-        return self._mix(x, xx), x[:, -1]
+        return self._mix(x, _token_shift_with_state(x, prev_x)), x[:, -1]
 
 
 class RwkvBlock(Layer):

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["wkv", "wkv_with_state", "wkv_reference"]
+__all__ = ["wkv", "wkv_with_state", "wkv_init_state", "wkv_reference"]
 
 
 def wkv_with_state(w, u, k, v, state):

@@ -253,3 +253,89 @@ def test_ernie_moe_sharded_matches_serial():
     finally:
         dist.set_hybrid_group(None)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+# -- DiT sampling (DDIM + classifier-free guidance) ---------------------------
+
+def test_ddim_sample_shapes_determinism_and_cfg():
+    from paddle_tpu.models.dit import DiT, tiny_dit_config
+    from paddle_tpu.models.diffusion import ddim_sample
+
+    pt.seed(3)
+    cfg = tiny_dit_config()
+    model = DiT(cfg)
+    model.eval()
+    y = jnp.asarray([0, 1], jnp.int32)
+    a = ddim_sample(model, y, steps=4, seed=0)
+    assert a.shape == (2, cfg.in_channels, cfg.input_size, cfg.input_size)
+    assert np.all(np.isfinite(np.asarray(a)))
+    # deterministic at eta=0 with the same seed; new seed → new sample
+    b = ddim_sample(model, y, steps=4, seed=0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+    c_ = ddim_sample(model, y, steps=4, seed=1)
+    assert not np.allclose(np.asarray(a), np.asarray(c_))
+    # cfg path (doubled batch through the null class): at INIT the AdaLN-
+    # Zero gates make the output y-independent (cfg == no-cfg by design),
+    # so perturb the params to give the conditioning a nonzero pathway
+    rng = np.random.RandomState(0)
+    noisy = {k: jnp.asarray(np.asarray(v)
+                            + 0.02 * rng.standard_normal(v.shape)
+                            .astype(np.float32))
+             for k, v in model.state_dict().items()}
+    model.set_state_dict(noisy, strict=False)
+    a2 = ddim_sample(model, y, steps=4, seed=0)
+    g = ddim_sample(model, y, steps=4, seed=0, cfg_scale=4.0)
+    assert g.shape == a.shape and np.all(np.isfinite(np.asarray(g)))
+    assert not np.allclose(np.asarray(g), np.asarray(a2))
+    # eta > 0 injects noise
+    e = ddim_sample(model, y, steps=4, seed=0, eta=1.0)
+    assert np.all(np.isfinite(np.asarray(e)))
+
+
+def test_ddim_sample_denoises_a_trained_target():
+    """Integration: train tiny DiT to denoise toward a constant latent,
+    then DDIM samples must land far closer to that constant than the
+    untrained model's samples do."""
+    from paddle_tpu.models.dit import DiT, tiny_dit_config
+    from paddle_tpu.models.diffusion import ddim_sample, diffusion_schedule
+    from paddle_tpu.nn.layer import functional_call
+    from paddle_tpu.optimizer import AdamW
+
+    pt.seed(11)
+    cfg = tiny_dit_config()
+    model = DiT(cfg)
+    target = 0.7  # every pixel of the "dataset" latent
+    acp = diffusion_schedule()
+    params = model.trainable_state()
+    opt = AdamW(learning_rate=2e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, key):
+        k1, k2 = jax.random.split(key)
+        t = jax.random.randint(k1, (8,), 0, 1000)
+        noise = jax.random.normal(
+            k2, (8, cfg.in_channels, cfg.input_size, cfg.input_size))
+        a = acp[t][:, None, None, None]
+        x0 = jnp.full_like(noise, target)
+        xt = jnp.sqrt(a) * x0 + jnp.sqrt(1 - a) * noise
+        y = jnp.zeros((8,), jnp.int32)
+        pred = functional_call(model, p, xt, t, y)[:, :cfg.in_channels]
+        return jnp.mean((pred.astype(jnp.float32) - noise) ** 2)
+
+    @jax.jit
+    def step(p, o, key):
+        l, g = jax.value_and_grad(loss_fn)(p, key)
+        p, o = opt.update(g, o, p)
+        return l, p, o
+
+    model.eval()
+    before = ddim_sample(model, jnp.zeros((4,), jnp.int32), steps=8, seed=3)
+    key = jax.random.key(0)
+    for i in range(150):
+        key, sub = jax.random.split(key)
+        _, params, opt_state = step(params, opt_state, sub)
+    model.set_state_dict(params, strict=False)
+    after = ddim_sample(model, jnp.zeros((4,), jnp.int32), steps=8, seed=3)
+    err_before = float(jnp.mean(jnp.abs(before - target)))
+    err_after = float(jnp.mean(jnp.abs(after - target)))
+    assert err_after < err_before * 0.6, (err_before, err_after)
