@@ -14,13 +14,16 @@ thread pool for the per-sample ``__getitem__`` calls (numpy releases the
 GIL), keeping the reference's knob meaningful without fork overhead.
 """
 
+from . import native
 from .dataloader import (BatchSampler, DataLoader, Dataset,
                          DistributedBatchSampler, IterableDataset,
                          RandomSampler, Sampler, SequenceSampler,
                          TensorDataset, default_collate_fn)
+from .native import MMapTokenDataset, NativeTokenLoader
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "Sampler",
     "SequenceSampler", "RandomSampler", "BatchSampler",
     "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+    "MMapTokenDataset", "NativeTokenLoader", "native",
 ]

@@ -1,0 +1,254 @@
+// Native data-loader core: mmap token dataset + threaded batch producer.
+//
+// TPU-native equivalent of the reference's C++ DataLoader machinery
+// (upstream layout: paddle/fluid/operators/reader/ buffered_reader +
+// python/paddle/io/dataloader worker pool — there a process pool feeding
+// a LoDTensor blocking queue, here a thread pool filling a slot ring).
+// The hot loop a Python loader cannot do well: page-cache-friendly mmap
+// reads, zero-Python-object batch assembly, and a deterministic
+// shuffle/shard schedule computed in native code.
+//
+// Determinism contract (tested from Python against a NumPy oracle):
+//   perm  = fisher_yates(splitmix64(seed ^ epoch), num_samples)
+//   shard = perm[i] for i in [0, n) with i % world == rank   (round-robin
+//           over the SHUFFLED order — every rank sees a disjoint set)
+//   batch j = shard[j*B .. (j+1)*B)   (drop_last: tail batch dropped)
+// Workers race to fill slots but batch j is always delivered j-th: the
+// ring has per-slot sequence numbers; the consumer blocks on slot j%cap
+// carrying sequence j (the classic bounded in-order MPMC ring).
+//
+// Build: g++ -O2 -shared -fPIC -pthread (driven from paddle_tpu/io/native.py).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// splitmix64: tiny, seedable, good-enough PRNG for shuffles; the Python
+// oracle in tests/test_native_io.py mirrors it bit for bit.
+struct SplitMix64 {
+  uint64_t state;
+  explicit SplitMix64(uint64_t seed) : state(seed) {}
+  uint64_t next() {
+    uint64_t z = (state += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+  // unbiased bounded draw (rejection sampling)
+  uint64_t below(uint64_t bound) {
+    uint64_t threshold = (~bound + 1) % bound;  // 2^64 mod bound
+    for (;;) {
+      uint64_t r = next();
+      if (r >= threshold) return r % bound;
+    }
+  }
+};
+
+struct Dataset {
+  int fd = -1;
+  const uint8_t* base = nullptr;
+  size_t bytes = 0;
+  int dtype_code = 0;  // 2 = uint16, 4 = int32
+  int64_t seq_len = 0;   // tokens per sample (callers add +1 for labels)
+  int64_t stride = 0;    // tokens between sample starts
+  int64_t num_tokens = 0;
+  int64_t num_samples = 0;
+};
+
+struct Loader {
+  Dataset* ds = nullptr;
+  int64_t batch = 0;
+  int64_t num_batches = 0;
+  std::vector<int64_t> shard;       // this rank's shuffled sample indices
+  // slot ring
+  int64_t capacity = 0;
+  std::vector<int32_t> slots;       // capacity * batch * seq_len
+  std::vector<int64_t> slot_seq;    // which batch occupies the slot (-1 none)
+  std::vector<uint8_t> slot_ready;
+  std::atomic<int64_t> next_fill{0};
+  int64_t next_read = 0;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+};
+
+void fill_one(Loader* L, int64_t b) {
+  const Dataset* d = L->ds;
+  const int64_t slot = b % L->capacity;
+  {
+    // claim the slot only once it is free AND b is within the live window
+    // [next_read, next_read + capacity): batches b and b + capacity share
+    // a slot, and without the window check the later one could steal it
+    // and deadlock the in-order consumer
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_free.wait(lk, [&] {
+      return L->stop.load() ||
+             (L->slot_seq[slot] == -1 && b < L->next_read + L->capacity);
+    });
+    if (L->stop.load()) return;
+    L->slot_seq[slot] = b;
+  }
+  int32_t* out = L->slots.data() + slot * L->batch * d->seq_len;
+  for (int64_t r = 0; r < L->batch; ++r) {
+    const int64_t sample = L->shard[b * L->batch + r];
+    const int64_t tok0 = sample * d->stride;
+    if (d->dtype_code == 2) {
+      const uint16_t* src =
+          reinterpret_cast<const uint16_t*>(d->base) + tok0;
+      for (int64_t t = 0; t < d->seq_len; ++t) out[r * d->seq_len + t] = src[t];
+    } else {
+      const int32_t* src = reinterpret_cast<const int32_t*>(d->base) + tok0;
+      std::memcpy(out + r * d->seq_len, src, d->seq_len * sizeof(int32_t));
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->slot_ready[slot] = 1;
+  }
+  L->cv_ready.notify_all();
+}
+
+void worker_loop(Loader* L) {
+  for (;;) {
+    const int64_t b = L->next_fill.fetch_add(1);
+    if (b >= L->num_batches || L->stop.load()) return;
+    fill_one(L, b);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptio_open(const char* path, int dtype_code, int64_t seq_len,
+                int64_t stride) {
+  if ((dtype_code != 2 && dtype_code != 4) || seq_len <= 0 || stride <= 0)
+    return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = ::mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* d = new Dataset();
+  d->fd = fd;
+  d->base = static_cast<const uint8_t*>(base);
+  d->bytes = st.st_size;
+  d->dtype_code = dtype_code;
+  d->seq_len = seq_len;
+  d->stride = stride;
+  d->num_tokens = static_cast<int64_t>(st.st_size) / dtype_code;
+  d->num_samples = (d->num_tokens >= seq_len)
+                       ? (d->num_tokens - seq_len) / stride + 1
+                       : 0;
+  return d;
+}
+
+int64_t ptio_num_samples(void* ds) {
+  return ds ? static_cast<Dataset*>(ds)->num_samples : -1;
+}
+
+void ptio_close(void* ds) {
+  if (!ds) return;
+  auto* d = static_cast<Dataset*>(ds);
+  ::munmap(const_cast<uint8_t*>(d->base), d->bytes);
+  ::close(d->fd);
+  delete d;
+}
+
+void* ptio_loader_new(void* ds, int64_t batch, uint64_t seed, uint64_t epoch,
+                      int64_t rank, int64_t world, int workers,
+                      int64_t capacity, int shuffle) {
+  auto* d = static_cast<Dataset*>(ds);
+  if (!d || batch <= 0 || world <= 0 || rank < 0 || rank >= world ||
+      workers <= 0 || capacity <= 0)
+    return nullptr;
+  auto* L = new Loader();
+  L->ds = d;
+  L->batch = batch;
+  // global shuffled permutation (identical on every rank), then the
+  // round-robin shard — the DistributedBatchSampler contract
+  std::vector<int64_t> perm(d->num_samples);
+  for (int64_t i = 0; i < d->num_samples; ++i) perm[i] = i;
+  if (shuffle) {
+    SplitMix64 rng(seed ^ (0x9e3779b97f4a7c15ULL * (epoch + 1)));
+    for (int64_t i = d->num_samples - 1; i > 0; --i) {
+      const int64_t j = static_cast<int64_t>(rng.below(i + 1));
+      std::swap(perm[i], perm[j]);
+    }
+  }
+  for (int64_t i = rank; i < d->num_samples; i += world)
+    L->shard.push_back(perm[i]);
+  L->num_batches = static_cast<int64_t>(L->shard.size()) / batch;  // drop_last
+  L->capacity = capacity;
+  L->slots.resize(capacity * batch * d->seq_len);
+  L->slot_seq.assign(capacity, -1);
+  L->slot_ready.assign(capacity, 0);
+  const int n_workers = std::min<int64_t>(workers, std::max<int64_t>(
+                                                       L->num_batches, 1));
+  for (int w = 0; w < n_workers; ++w)
+    L->workers.emplace_back(worker_loop, L);
+  return L;
+}
+
+int64_t ptio_loader_num_batches(void* loader) {
+  return loader ? static_cast<Loader*>(loader)->num_batches : -1;
+}
+
+// Copies batch ``next_read`` into out (int32, batch*seq_len) and frees the
+// slot.  Returns 1 on success, 0 when exhausted.
+int ptio_loader_next(void* loader, int32_t* out) {
+  auto* L = static_cast<Loader*>(loader);
+  if (!L || L->next_read >= L->num_batches) return 0;
+  const int64_t b = L->next_read;
+  const int64_t slot = b % L->capacity;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_ready.wait(lk, [&] {
+      return L->stop.load() ||
+             (L->slot_seq[slot] == b && L->slot_ready[slot]);
+    });
+    if (L->stop.load()) return 0;
+  }
+  std::memcpy(out, L->slots.data() + slot * L->batch * L->ds->seq_len,
+              L->batch * L->ds->seq_len * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->slot_ready[slot] = 0;
+    L->slot_seq[slot] = -1;
+    L->next_read = b + 1;
+  }
+  L->cv_free.notify_all();
+  return 1;
+}
+
+void ptio_loader_free(void* loader) {
+  if (!loader) return;
+  auto* L = static_cast<Loader*>(loader);
+  L->stop.store(true);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"
