@@ -107,6 +107,15 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "gather", "irecv", "isend", "recv", "reduce", "reduce_scatter",
         "scatter", "send",
     ],
+    "paddle.optimizer": [
+        "Adagrad", "Adam", "AdamW", "Adamax", "Lamb", "Momentum",
+        "Optimizer", "RMSProp", "SGD",
+    ],
+    "paddle.optimizer.lr": [
+        "ConstantLR", "CosineAnnealingDecay", "ExponentialDecay",
+        "LRScheduler", "LinearWarmup", "MultiStepDecay", "NoamDecay",
+        "PolynomialDecay", "StepDecay",
+    ],
 }
 
 # Paddle names whose implementation deliberately lives under a different
@@ -134,6 +143,8 @@ _IMPL_MODULES: Dict[str, List[str]] = {
     "paddle.nn.functional": ["paddle_tpu.nn.functional"],
     "paddle.incubate": ["paddle_tpu.ops"],
     "paddle.distributed": ["paddle_tpu.distributed.collective"],
+    "paddle.optimizer": ["paddle_tpu.optimizer"],
+    "paddle.optimizer.lr": ["paddle_tpu.optimizer.lr"],
 }
 
 

@@ -244,7 +244,13 @@ int ptio_loader_next(void* loader, int32_t* out) {
 void ptio_loader_free(void* loader) {
   if (!loader) return;
   auto* L = static_cast<Loader*>(loader);
-  L->stop.store(true);
+  {
+    // store+notify under the mutex: without it a worker can test its wait
+    // predicate (stop still false) and block AFTER the notify — a lost
+    // wakeup that deadlocks the join below
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop.store(true);
+  }
   L->cv_free.notify_all();
   L->cv_ready.notify_all();
   for (auto& t : L->workers) t.join();

@@ -27,7 +27,8 @@ from ..nn.layer import Layer
 from . import lr as lr_mod
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW",
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adamax", "RMSProp", "Lamb",
            "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue", "lr"]
 
 lr = lr_mod
@@ -224,3 +225,117 @@ class AdamW(Adam):
                  epsilon=1e-8, weight_decay: float = 0.01, **kw):
         super().__init__(learning_rate, beta1=beta1, beta2=beta2,
                          epsilon=epsilon, weight_decay=weight_decay, **kw)
+
+
+class Adagrad(Optimizer):
+    """Parity: ``paddle.optimizer.Adagrad`` (adagrad.py, upstream layout)."""
+
+    def __init__(self, learning_rate=0.001, epsilon: float = 1e-6,
+                 initial_accumulator_value: float = 0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _slot_names(self):
+        return ("moment",)
+
+    def init(self, params):
+        state = super().init(params)
+        if self.initial_accumulator_value:
+            state["moment"] = {k: jnp.full(v.shape,
+                                           self.initial_accumulator_value,
+                                           jnp.float32)
+                               for k, v in params.items()}
+        return state
+
+    def _apply_one(self, name, p32, g32, lr_t, step, decay_on, slots):
+        g32 = g32 + self.weight_decay * decay_on * p32
+        acc = slots["moment"] + jnp.square(g32)
+        return (p32 - lr_t * g32 / (jnp.sqrt(acc) + self.epsilon),
+                {"moment": acc})
+
+
+class Adamax(Optimizer):
+    """Adam with the infinity norm (parity: ``paddle.optimizer.Adamax``)."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _slot_names(self):
+        return ("moment", "inf_norm")
+
+    def _apply_one(self, name, p32, g32, lr_t, step, decay_on, slots):
+        g32 = g32 + self.weight_decay * decay_on * p32
+        m = self.beta1 * slots["moment"] + (1 - self.beta1) * g32
+        u = jnp.maximum(self.beta2 * slots["inf_norm"], jnp.abs(g32))
+        t = step.astype(jnp.float32)
+        p_new = p32 - (lr_t / (1 - self.beta1 ** t)) * m / (u + self.epsilon)
+        return p_new, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    """Parity: ``paddle.optimizer.RMSProp`` (rho/momentum/centered knobs)."""
+
+    def __init__(self, learning_rate=0.001, rho: float = 0.95,
+                 epsilon: float = 1e-6, momentum: float = 0.0,
+                 centered: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def _slot_names(self):
+        names = ["mean_square", "velocity"]
+        if self.centered:
+            names.append("mean_grad")
+        return tuple(names)
+
+    def _apply_one(self, name, p32, g32, lr_t, step, decay_on, slots):
+        g32 = g32 + self.weight_decay * decay_on * p32
+        ms = self.rho * slots["mean_square"] + (1 - self.rho) * jnp.square(g32)
+        out = {"mean_square": ms}
+        denom = ms
+        if self.centered:
+            mg = self.rho * slots["mean_grad"] + (1 - self.rho) * g32
+            out["mean_grad"] = mg
+            denom = ms - jnp.square(mg)
+        upd = g32 / jnp.sqrt(denom + self.epsilon)
+        vel = self.momentum * slots["velocity"] + lr_t * upd
+        out["velocity"] = vel
+        return p32 - vel, out
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (parity:
+    ``paddle.optimizer.Lamb``; the LAMB paper's trust-ratio scaling of the
+    AdamW update, per parameter tensor)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-6,
+                 exclude_from_weight_decay_fn: Optional[
+                     Callable[[str], bool]] = None, **kw):
+        super().__init__(learning_rate, weight_decay=lamb_weight_decay, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._exclude = exclude_from_weight_decay_fn
+
+    def _slot_names(self):
+        return ("moment1", "moment2")
+
+    def _apply_one(self, name, p32, g32, lr_t, step, decay_on, slots):
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g32
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon)
+        # both exemption knobs respected: the LAMB-specific
+        # exclude_from_weight_decay_fn and the base apply_decay_param_fun
+        # mask (decay_on) every other optimizer honours
+        if not (self._exclude is not None and self._exclude(name)):
+            r = r + self.weight_decay * decay_on * p32
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p32 - lr_t * ratio * r, {"moment1": m, "moment2": v}

@@ -24,6 +24,8 @@ FLOOR = {
     "paddle.nn.functional": 33,
     "paddle.incubate": 6,
     "paddle.distributed": 13,
+    "paddle.optimizer": 9,
+    "paddle.optimizer.lr": 9,
 }
 
 

@@ -3,6 +3,7 @@ clipping, schedulers, jit-compiled updates.
 Pattern: test/legacy_test/test_adamw_op.py et al. (upstream layout)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -193,3 +194,130 @@ def test_state_treedef_stable_for_scan():
 
     (p3, s3), _ = jax.lax.scan(body, (p, s), None, length=3)
     assert int(s3["step"]) == 3
+
+
+# -- round-3 breadth: Adagrad / Adamax / RMSProp / Lamb -----------------------
+
+def _two_step(o, w, g1, g2):
+    p = {"w": jnp.asarray(w)}
+    s = o.init(p)
+    p, s = o.update({"w": jnp.asarray(g1)}, s, p)
+    p, s = o.update({"w": jnp.asarray(g2)}, s, p)
+    return np.asarray(p["w"])
+
+
+def test_adagrad_oracle():
+    rng = np.random.default_rng(1)
+    w, g1, g2 = (rng.normal(size=(4,)).astype(np.float32) for _ in range(3))
+    lr, eps = 0.1, 1e-6
+    acc = g1 * g1
+    want = w - lr * g1 / (np.sqrt(acc) + eps)
+    acc = acc + g2 * g2
+    want = want - lr * g2 / (np.sqrt(acc) + eps)
+    got = _two_step(opt.Adagrad(learning_rate=lr, epsilon=eps), w, g1, g2)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # initial accumulator value
+    o = opt.Adagrad(learning_rate=lr, initial_accumulator_value=0.5)
+    s = o.init({"w": jnp.asarray(w)})
+    np.testing.assert_allclose(np.asarray(s["moment"]["w"]), 0.5)
+
+
+def test_adamax_oracle():
+    rng = np.random.default_rng(2)
+    w, g1, g2 = (rng.normal(size=(4,)).astype(np.float32) for _ in range(3))
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    m = u = np.zeros_like(w)
+    want = w.copy()
+    for t, g in ((1, g1), (2, g2)):
+        m = b1 * m + (1 - b1) * g
+        u = np.maximum(b2 * u, np.abs(g))
+        want = want - (lr / (1 - b1 ** t)) * m / (u + eps)
+    got = _two_step(opt.Adamax(learning_rate=lr, beta1=b1, beta2=b2,
+                               epsilon=eps), w, g1, g2)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_rmsprop_oracle_centered_momentum():
+    rng = np.random.default_rng(3)
+    w, g1, g2 = (rng.normal(size=(4,)).astype(np.float32) for _ in range(3))
+    lr, rho, eps, mom = 0.01, 0.9, 1e-6, 0.8
+    ms = mg = vel = np.zeros_like(w)
+    want = w.copy()
+    for g in (g1, g2):
+        ms = rho * ms + (1 - rho) * g * g
+        mg = rho * mg + (1 - rho) * g
+        vel = mom * vel + lr * g / np.sqrt(ms - mg * mg + eps)
+        want = want - vel
+    got = _two_step(opt.RMSProp(learning_rate=lr, rho=rho, epsilon=eps,
+                                momentum=mom, centered=True), w, g1, g2)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_lamb_trust_ratio_and_exclusion():
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(6,)).astype(np.float32)
+    g = rng.normal(size=(6,)).astype(np.float32)
+    lr, b1, b2, eps, wd = 0.01, 0.9, 0.999, 1e-6, 0.1
+    m = (1 - b1) * g
+    v = (1 - b2) * g * g
+    r = (m / (1 - b1)) / (np.sqrt(v / (1 - b2)) + eps) + wd * w
+    ratio = np.linalg.norm(w) / np.linalg.norm(r)
+    want = w - lr * ratio * r
+
+    o = opt.Lamb(learning_rate=lr, lamb_weight_decay=wd, beta1=b1, beta2=b2,
+                 epsilon=eps)
+    p = {"w": jnp.asarray(w)}
+    s = o.init(p)
+    new_p, _ = o.update({"w": jnp.asarray(g)}, s, p)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), want, rtol=1e-5)
+
+    # exclusion: no weight decay term for excluded names
+    o2 = opt.Lamb(learning_rate=lr, lamb_weight_decay=wd, beta1=b1, beta2=b2,
+                  epsilon=eps, exclude_from_weight_decay_fn=lambda n: True)
+    r2 = (m / (1 - b1)) / (np.sqrt(v / (1 - b2)) + eps)
+    ratio2 = np.linalg.norm(w) / np.linalg.norm(r2)
+    want2 = w - lr * ratio2 * r2
+    new_p2, _ = o2.update({"w": jnp.asarray(g)}, o2.init(p), p)
+    np.testing.assert_allclose(np.asarray(new_p2["w"]), want2, rtol=1e-5)
+
+
+@pytest.mark.parametrize("cls", [opt.Adagrad, opt.Adamax, opt.RMSProp,
+                                 opt.Lamb])
+def test_new_optimizers_work_inside_jit_and_train(cls):
+    pt.seed(0)
+    net = nn.Linear(4, 1)
+    params = net.trainable_state()
+    o = cls(learning_rate=0.05)
+    state = o.init(params)
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 4), jnp.float32)
+    y = x @ jnp.asarray([[1.0], [2.0], [-1.0], [0.5]]) + 0.3
+
+    from paddle_tpu.nn.layer import functional_call
+
+    @jax.jit
+    def step(p, s):
+        def loss(p):
+            return jnp.mean((functional_call(net, p, x) - y) ** 2)
+        l, g = jax.value_and_grad(loss)(p)
+        p, s = o.update(g, s, p)
+        return l, p, s
+
+    losses = []
+    for _ in range(30):
+        l, params, state = step(params, state)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8, (cls.__name__, losses[::10])
+
+
+def test_lamb_respects_apply_decay_param_fun():
+    w = np.array([1.0, -2.0, 3.0], np.float32)
+    g = np.array([0.1, 0.2, -0.1], np.float32)
+    o = opt.Lamb(learning_rate=0.01, lamb_weight_decay=0.5,
+                 apply_decay_param_fun=lambda n: False)  # exempt everything
+    o_ref = opt.Lamb(learning_rate=0.01, lamb_weight_decay=0.5,
+                     exclude_from_weight_decay_fn=lambda n: True)
+    p = {"w": jnp.asarray(w)}
+    a, _ = o.update({"w": jnp.asarray(g)}, o.init(p), p)
+    b, _ = o_ref.update({"w": jnp.asarray(g)}, o_ref.init(p), p)
+    np.testing.assert_allclose(np.asarray(a["w"]), np.asarray(b["w"]),
+                               rtol=1e-6)
