@@ -21,14 +21,15 @@ from .flags import get_flags, set_flags
 # ``paddle.concat``/``paddle.matmul`` (upstream python/paddle/__init__.py)
 from .tensor import *  # noqa: F401,F403
 from .tensor import Tensor, __all__ as _tensor_all
-from .hapi import Model
+from .hapi import Model, summary
 
 __version__ = "0.1.0"
 
 __all__ = [
     "amp", "distributed", "flags", "framework", "hapi", "inference", "io",
-    "jit", "metric", "nn", "optimizer", "profiler", "tensor", "utils",
-    "Model",
+    "jit", "metric", "nn", "optimizer", "profiler", "static", "tensor",
+    "utils",
+    "Model", "summary",
     "seed", "to_tensor", "device_count", "is_compiled_with_tpu",
     "get_default_dtype", "set_default_dtype", "get_flags", "set_flags",
     "save", "load", "__version__",

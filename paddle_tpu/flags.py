@@ -107,9 +107,16 @@ DEFINE("moe_dispatch", "dense",
 DEFINE("flash_attention_force", False,
        "error instead of silently falling back to the XLA reference path "
        "when the Pallas flash-attention kernel is ineligible")
-DEFINE("flash_attention_block_q", 256, "Pallas flash-attention q block size")
+# flash block defaults from a v5e sweep on the bench workload (llama3-arch
+# 4L, bs2 x seq2048, head_dim 128, GQA 32/8 — full train-step MFU):
+#  (bq,bkv): (256,512)=0.579  (512,512)=0.598  (512,1024)=0.611
+#            (1024,1024)=0.624  (1024,2048)=VMEM OOM
+# larger q tiles amortise the kv streaming; 1024x1024 is the VMEM ceiling
+DEFINE("flash_attention_block_q", 1024,
+       "Pallas flash-attention q block size")
 DEFINE("rms_norm_pallas_min_dim", 32768,
        "route standalone rms_norm rows at least this long to the Pallas "
        "single-visit kernel; threshold set from v5e measurements "
        "(ops/norms.py docstring) — below it XLA is as fast or faster")
-DEFINE("flash_attention_block_kv", 512, "Pallas flash-attention kv block size")
+DEFINE("flash_attention_block_kv", 1024,
+       "Pallas flash-attention kv block size")

@@ -20,8 +20,9 @@ import numpy as np
 from ..nn.layer import Layer, bind_params
 from . import callbacks as callbacks_mod
 from .callbacks import Callback, CallbackList, ProgBarLogger
+from .summary import summary
 
-__all__ = ["Model", "callbacks"]
+__all__ = ["Model", "callbacks", "summary"]
 
 callbacks = callbacks_mod
 

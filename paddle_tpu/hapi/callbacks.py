@@ -12,7 +12,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "LRSchedulerCallback", "EarlyStopping"]
+           "LRSchedulerCallback", "EarlyStopping", "VisualDL"]
 
 
 class Callback:
@@ -188,3 +188,67 @@ class EarlyStopping(Callback):
         if self.wait > self.patience:
             self.stopped_epoch = epoch
             self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (parity: ``paddle.callbacks.VisualDL``).
+
+    The reference writes VisualDL event files.  Here every scalar ALWAYS
+    goes to a newline-delimited JSON file (``scalars.jsonl``: one
+    ``{"tag", "step", "value", "wall_time"}`` record each) — a durable
+    format any dashboard can tail with no display dependency — and, when
+    torch's ``SummaryWriter`` is importable, to TensorBoard event files
+    as well.
+    """
+
+    def __init__(self, log_dir: str = "./vdl_log", log_freq: int = 1):
+        super().__init__()
+        self.log_dir = log_dir
+        self.log_freq = max(1, int(log_freq))
+        self._file = None
+        self._tb = None
+        self._global_step = 0
+
+    def _open(self):
+        if self._file is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._file = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                              "a", buffering=1)
+            try:  # optional tensorboard writer, never required
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb = SummaryWriter(self.log_dir)
+            except Exception:
+                self._tb = None
+
+    def _scalar(self, tag: str, value, step: int):
+        import json
+
+        self._open()
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        self._file.write(json.dumps(
+            {"tag": tag, "step": step, "value": v,
+             "wall_time": time.time()}) + "\n")
+        if self._tb is not None:
+            self._tb.add_scalar(tag, v, step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self._global_step % self.log_freq:
+            return
+        for k, v in (logs or {}).items():
+            self._scalar(f"train/{k}", v, self._global_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            self._scalar(f"epoch/{k}", v, epoch)
+
+    def on_train_end(self, logs=None):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None

@@ -1,0 +1,77 @@
+"""Model summary (parity: ``paddle.summary`` — python/paddle/hapi/
+model_summary.py, upstream layout).
+
+The reference hooks every sublayer's forward to capture output shapes;
+here shapes come from ``jax.eval_shape`` over the functional bridge —
+abstract evaluation, no FLOPs spent and no device memory touched, which
+also means it works for models far larger than the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer, functional_call
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size: Optional[Union[Tuple, list]] = None,
+            dtypes=None, input: Optional[tuple] = None,
+            print_fn=print) -> Dict[str, Any]:
+    """Print a per-layer parameter table and return the totals.
+
+    ``input_size``: one shape tuple or a list of them (batch dim included,
+    like the reference); ``input``: alternatively, concrete example
+    arrays.  Output shapes are computed abstractly via ``jax.eval_shape``
+    when inputs are given; otherwise only the parameter table is printed.
+    """
+    rows = []
+    total = trainable = 0
+    for lname, sub in net.named_sublayers(include_self=True):
+        own = [(pn, p) for pn, p in sub.named_parameters()
+               if "." not in pn]  # direct params only, no double counting
+        if not own:
+            continue
+        n = sum(int(np.prod(p.shape)) for _, p in own)
+        t = sum(int(np.prod(p.shape)) for _, p in own if p.trainable)
+        shapes = ", ".join(f"{pn}{tuple(p.shape)}" for pn, p in own)
+        rows.append((lname or type(net).__name__, type(sub).__name__,
+                     shapes, n))
+        total += n
+        trainable += t
+
+    out_shape = None
+    if input is None and input_size is not None:
+        sizes = (input_size if isinstance(input_size, list)
+                 else [input_size])
+        dts = dtypes if dtypes is not None else ["float32"] * len(sizes)
+        # abstract specs, not real zeros: eval_shape never touches device
+        # memory, so neither should building its inputs
+        input = tuple(jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                      for s, d in zip(sizes, dts))
+    if input is not None:
+        params = net.state_dict(include_buffers=True)
+        abstract = jax.eval_shape(
+            lambda p, *a: functional_call(net, p, *a), params, *input)
+        out_shape = jax.tree.map(lambda x: tuple(x.shape), abstract)
+
+    w = max([len(r[0]) for r in rows] + [10])
+    sep = "-" * (w + 50)
+    print_fn(sep)
+    print_fn(f"{'Layer':<{w}}  {'Type':<22}  {'Params':>12}")
+    print_fn(sep)
+    for lname, tname, shapes, n in rows:
+        print_fn(f"{lname:<{w}}  {tname:<22}  {n:>12,}")
+    print_fn(sep)
+    print_fn(f"Total params: {total:,}")
+    print_fn(f"Trainable params: {trainable:,}")
+    print_fn(f"Non-trainable params: {total - trainable:,}")
+    if out_shape is not None:
+        print_fn(f"Output shape: {out_shape}")
+    print_fn(sep)
+    return {"total_params": total, "trainable_params": trainable}

@@ -39,14 +39,31 @@ NEG_INF = -1e30
 _LANES = 128  # VPU lane width: m/l scratch rows are padded to this
 
 
-def _block_sizes(sq: int, skv: int):
+def _aligned_divisor(seq: int, cap: int, align: int) -> int:
+    """Largest block <= cap that divides seq on the (8,128) register
+    tiling — so any aligned seq gets the kernel at the best dividing tile
+    instead of falling back when the flag doesn't divide it."""
+    for d in range(min(cap, seq), 0, -1):
+        if seq % d == 0 and d % align == 0:
+            return d
+    return min(cap, seq)  # none aligned: _validate rejects → XLA path
+
+
+def _block_sizes(sq: int, skv: int, head_dim: int):
     """Tile sizes for the Pallas grid; tunable via the
     ``flash_attention_block_q``/``flash_attention_block_kv`` flags (parity:
-    the reference's FLAGS-tuned fused-attention tiling)."""
+    the reference's FLAGS-tuned fused-attention tiling).
+
+    The flag values are swept at head_dim 128 (see flags.py); for larger
+    heads the caps scale down by d/128 so the fp32 scores + q/kv/acc tiles
+    stay inside VMEM — a Mosaic OOM is a hard compile error, not a
+    catchable fallback."""
     from ...flags import flag
-    bq = min(int(flag("flash_attention_block_q")), sq)
-    bk = min(int(flag("flash_attention_block_kv")), skv)
-    return bq, bk
+    scale = max(1, head_dim // 128)
+    cap_q = max(8, int(flag("flash_attention_block_q")) // scale)
+    cap_k = max(128, int(flag("flash_attention_block_kv")) // scale)
+    return (_aligned_divisor(sq, cap_q, 8),
+            _aligned_divisor(skv, cap_k, 128))
 
 
 def _validate(q, k, v, sq, skv, bq, bk):
@@ -54,6 +71,13 @@ def _validate(q, k, v, sq, skv, bq, bk):
         raise NotImplementedError(
             f"flash kernel needs seq divisible by block ({sq}%{bq}, "
             f"{skv}%{bk})")
+    if bq % 8 or bk % 128:
+        # scores tile is (bq sublanes x bk lanes): keep blocks on the
+        # (8, 128) register tiling; odd seqs shorter than the block would
+        # otherwise become odd-sized single blocks — let those take the
+        # XLA path instead of a Mosaic corner case
+        raise NotImplementedError(
+            f"flash kernel blocks must align to (8, 128), got ({bq}, {bk})")
     if q.shape[-1] != k.shape[-1] or k.shape[:2] != v.shape[:2]:
         raise NotImplementedError("q/k/v head_dim mismatch")
     if k.shape[1] == 0 or q.shape[1] % k.shape[1]:
@@ -130,7 +154,7 @@ def _fwd(q, k, v, scale: float, causal: bool, interpret: bool = False):
     b, hq, sq, d = q.shape
     _, hkv, skv, _ = k.shape
     g = hq // hkv
-    bq, bk = _block_sizes(sq, skv)
+    bq, bk = _block_sizes(sq, skv, d)
     offset = skv - sq
     kv_steps = skv // bk
 
@@ -272,7 +296,7 @@ def _bwd(scale, causal, interpret, res, grads):
     b, hq, sq, d = q.shape
     _, hkv, skv, _ = k.shape
     g = hq // hkv
-    bq, bk = _block_sizes(sq, skv)
+    bq, bk = _block_sizes(sq, skv, d)
     offset = skv - sq
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # (b, hq, sq)
@@ -377,7 +401,7 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     _, skv, hkv, _ = k.shape
     if scale is None:
         scale = d ** -0.5
-    bq, bk = _block_sizes(sq, skv)
+    bq, bk = _block_sizes(sq, skv, d)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)

@@ -181,14 +181,18 @@ def test_export_chrome_tracing_directs_output(tmp_path):
 def test_flash_attention_block_flags_are_live():
     from paddle_tpu.ops.pallas.flash_attention import _block_sizes
 
-    assert _block_sizes(4096, 4096) == (256, 512)
+    assert _block_sizes(4096, 4096, 128) == (1024, 1024)  # swept defaults
+    # non-dividing flag: largest aligned divisor wins (1536 % 1024 != 0)
+    assert _block_sizes(1536, 1536, 128) == (768, 768)
+    # head_dim > 128 scales the caps down to stay inside VMEM
+    assert _block_sizes(4096, 4096, 256) == (512, 512)
     pt.set_flags({"flash_attention_block_q": 128,
                   "flash_attention_block_kv": 256})
     try:
-        assert _block_sizes(4096, 4096) == (128, 256)
+        assert _block_sizes(4096, 4096, 128) == (128, 256)
     finally:
-        pt.set_flags({"flash_attention_block_q": 256,
-                      "flash_attention_block_kv": 512})
+        pt.set_flags({"flash_attention_block_q": 1024,
+                      "flash_attention_block_kv": 1024})
 
 
 def test_model_fit_rides_hybrid_mesh():
@@ -223,3 +227,46 @@ def test_model_fit_rides_hybrid_mesh():
         dist.set_hybrid_group(None)
     np.testing.assert_allclose(sharded["loss"], serial["loss"],
                                rtol=2e-4, atol=2e-5)
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    import json
+
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    pt.seed(0)
+    net = nn.Linear(4, 1)
+    model = hapi.Model(net)
+    model.prepare(optimizer=SGD(learning_rate=0.05),
+                  loss=lambda out, y: jnp.mean((out - y) ** 2))
+    log_dir = str(tmp_path / "vdl")
+    model.fit(list(_toy_data()), epochs=2, verbose=0,
+              callbacks=[VisualDL(log_dir=log_dir, log_freq=2)])
+    recs = [json.loads(l) for l in
+            open(log_dir + "/scalars.jsonl").read().splitlines()]
+    tags = {r["tag"] for r in recs}
+    assert "train/loss" in tags and "epoch/loss" in tags
+    train_steps = [r["step"] for r in recs if r["tag"] == "train/loss"]
+    assert train_steps == sorted(train_steps)
+    assert all(s % 2 == 0 for s in train_steps)  # log_freq honoured
+    assert all(np.isfinite(r["value"]) for r in recs)
+
+
+def test_summary_counts_and_shapes():
+    import paddle_tpu as ptp
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+
+    pt.seed(0)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    lines = []
+    info = ptp.summary(model, print_fn=lines.append)
+    want = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
+    assert info["total_params"] == want
+    assert info["trainable_params"] <= info["total_params"]
+    assert any("Total params" in l for l in lines)
+
+    # abstract output shape via eval_shape (no FLOPs)
+    info2 = ptp.summary(model, input_size=(2, 8), dtypes=["int32"],
+                        print_fn=lines.append)
+    assert info2["total_params"] == want
+    assert any("Output shape" in l and "(2, 8, 256)" in l for l in lines)
