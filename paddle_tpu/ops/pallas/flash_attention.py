@@ -88,9 +88,45 @@ def _validate(q, k, v, sq, skv, bq, bk):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_sc, m_sc, l_sc, *, scale, causal, offset, bq, bk,
-                kv_steps):
+def _seg_mask(sq_ref, skv_ref):
+    """Segment-id blocks → (bq, bk) same-document mask.
+
+    Blocks arrive pre-broadcast in Mosaic-friendly layouts (q ids over the
+    lane dim, kv ids over sublanes — the (8,128) tiling forbids raw (1, b)
+    blocks): sq_ref (1, bq, _LANES), skv_ref (1, 8, bk)."""
+    return sq_ref[0][:, :1] == skv_ref[0][:1, :]
+
+
+def _seg_broadcast(seg_q, seg_kv):
+    """(B, Sq)/(B, Skv) ids → lane/sublane-broadcast arrays for the grid."""
+    b, sq = seg_q.shape
+    skv = seg_kv.shape[1]
+    q3 = jnp.broadcast_to(seg_q.astype(jnp.int32)[:, :, None],
+                          (b, sq, _LANES))
+    kv3 = jnp.broadcast_to(seg_kv.astype(jnp.int32)[:, None, :],
+                           (b, 8, skv))
+    return q3, kv3
+
+
+def _mask_for(causal, segmented, bq, bk, q_start, kv_start, offset,
+              sq_ref, skv_ref):
+    mask = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (cols + kv_start) <= (rows + q_start + offset)
+    if segmented:
+        sm = _seg_mask(sq_ref, skv_ref)
+        mask = sm if mask is None else (mask & sm)
+    return mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, segmented,
+                offset, bq, bk, kv_steps):
+    if segmented:
+        sq_ref, skv_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
     ki = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -114,10 +150,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk)
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = (cols + kv_start) <= (rows + q_start + offset)
+        mask = _mask_for(causal, segmented, bq, bk, q_start, kv_start,
+                         offset, sq_ref if segmented else None,
+                         skv_ref if segmented else None)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_sc[:, :1]                                   # (bq, 1)
@@ -125,7 +161,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                        # rescale old
         p = jnp.exp(s - m_new)                                 # (bq, bk)
-        if causal:
+        if mask is not None:
             # exp(NEG_INF - NEG_INF) = 1 for fully-masked rows; zero it
             p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=1, keepdims=True)
@@ -149,29 +185,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             jnp.where(l > 0, lse, NEG_INF), (lse.shape[0], lse_ref.shape[-1]))
 
 
-def _fwd(q, k, v, scale: float, causal: bool, interpret: bool = False):
-    """q: (B, Hq, Sq, D); k, v: (B, Hkv, Skv, D) → (out, lse)."""
+def _fwd(q, k, v, seg_q=None, seg_kv=None, scale: float = 1.0,
+         causal: bool = False, interpret: bool = False):
+    """q: (B, Hq, Sq, D); k, v: (B, Hkv, Skv, D) → (out, lse).
+    seg_q/seg_kv: optional (B, Sq)/(B, Skv) int32 packed-document ids."""
     b, hq, sq, d = q.shape
     _, hkv, skv, _ = k.shape
     g = hq // hkv
     bq, bk = _block_sizes(sq, skv, d)
     offset = skv - sq
     kv_steps = skv // bk
+    segmented = seg_q is not None
 
     grid = (b, hq, sq // bq, skv // bk)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, offset=offset, bq=bq,
-        bk=bk, kv_steps=kv_steps)
+        _fwd_kernel, scale=scale, causal=causal, segmented=segmented,
+        offset=offset, bq=bq, bk=bk, kv_steps=kv_steps)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
+    ]
+    args = [q, k, v]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, bq, _LANES), lambda b_, h, qi, ki: (b_, qi, 0)),
+            pl.BlockSpec((1, 8, bk), lambda b_, h, qi, ki: (b_, 0, ki)),
+        ]
+        args += list(_seg_broadcast(seg_q, seg_kv))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h, qi, ki: (b_, h // g, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, _LANES),
@@ -190,7 +237,7 @@ def _fwd(q, k, v, scale: float, causal: bool, interpret: bool = False):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return out, lse  # lse lane-broadcast (b, hq, sq, _LANES); callers slice
 
 
@@ -198,8 +245,13 @@ def _fwd(q, k, v, scale: float, causal: bool, interpret: bool = False):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_sc, *, scale, causal, offset, bq, bk, kv_steps):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, segmented, offset, bq, bk, kv_steps):
+    if segmented:
+        sq_ref, skv_ref, dq_ref, dq_sc = rest
+    else:
+        sq_ref = skv_ref = None
+        dq_ref, dq_sc = rest
     ki = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -222,13 +274,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0, 0][:, :1]                   # (bq, 1)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = (cols + kv_start) <= (rows + q_start + offset)
+        mask = _mask_for(causal, segmented, bq, bk, q_start, kv_start,
+                         offset, sq_ref, skv_ref)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)                             # (bq, bk)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)  # kill exp(NEG_INF - NEG_INF) = 1
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -241,9 +292,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, offset,
-                    bq, bk, q_steps):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, segmented, offset, bq, bk, q_steps):
+    if segmented:
+        sq_ref, skv_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+    else:
+        sq_ref = skv_ref = None
+        dk_ref, dv_ref, dk_sc, dv_sc = rest
     qi = pl.program_id(3)
     ki = pl.program_id(2)
 
@@ -267,13 +322,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = (cols + kv_start) <= (rows + q_start + offset)
+        mask = _mask_for(causal, segmented, bq, bk, q_start, kv_start,
+                         offset, sq_ref, skv_ref)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)                              # (bq, bk)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)  # kill exp(NEG_INF - NEG_INF) = 1
         dv_sc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -290,7 +344,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(scale, causal, interpret, res, grads):
-    q, k, v, out, lse4 = res  # lse4: lane-broadcast residual from _fwd
+    q, k, v, seg_q, seg_kv, out, lse4 = res  # lse4: lane-broadcast residual
     do, dlse = grads
     do = do.astype(q.dtype)
     b, hq, sq, d = q.shape
@@ -298,6 +352,7 @@ def _bwd(scale, causal, interpret, res, grads):
     g = hq // hkv
     bq, bk = _block_sizes(sq, skv, d)
     offset = skv - sq
+    segmented = seg_q is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # (b, hq, sq)
     # the lse cotangent folds into the ds formula exactly:
@@ -306,10 +361,15 @@ def _bwd(scale, causal, interpret, res, grads):
     # lane-broadcast for TPU block tiling (last dim = _LANES); lse stays in
     # its broadcast layout from the forward — no slice/re-broadcast round trip
     delta4 = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+    seg_args = list(_seg_broadcast(seg_q, seg_kv)) if segmented else []
+
+    def seg_specs(ix_q, ix_kv):
+        return ([pl.BlockSpec((1, bq, _LANES), ix_q),
+                 pl.BlockSpec((1, 8, bk), ix_kv)] if segmented else [])
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, causal=causal, offset=offset, bq=bq,
-        bk=bk, kv_steps=skv // bk)
+        _bwd_dq_kernel, scale=scale, causal=causal, segmented=segmented,
+        offset=offset, bq=bq, bk=bk, kv_steps=skv // bk)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, hq, sq // bq, skv // bk),
@@ -324,7 +384,8 @@ def _bwd(scale, causal, interpret, res, grads):
                          lambda b_, h, qi, ki: (b_, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, _LANES),
                          lambda b_, h, qi, ki: (b_, h, qi, 0)),
-        ],
+        ] + seg_specs(lambda b_, h, qi, ki: (b_, qi, 0),
+                      lambda b_, h, qi, ki: (b_, 0, ki)),
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda b_, h, qi, ki: (b_, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -333,11 +394,11 @@ def _bwd(scale, causal, interpret, res, grads):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse4, delta4)
+    )(q, k, v, do, lse4, delta4, *seg_args)
 
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, offset=offset, bq=bq,
-        bk=bk, q_steps=sq // bq)
+        _bwd_dkv_kernel, scale=scale, causal=causal, segmented=segmented,
+        offset=offset, bq=bq, bk=bk, q_steps=sq // bq)
     # per-q-head dk/dv; grouped heads are reduced after the kernel
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -353,7 +414,8 @@ def _bwd(scale, causal, interpret, res, grads):
                          lambda b_, h, ki, qi: (b_, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, _LANES),
                          lambda b_, h, ki, qi: (b_, h, qi, 0)),
-        ],
+        ] + seg_specs(lambda b_, h, ki, qi: (b_, qi, 0),
+                      lambda b_, h, ki, qi: (b_, 0, ki)),
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h, ki, qi: (b_, h, ki, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h, ki, qi: (b_, h, ki, 0)),
@@ -368,26 +430,31 @@ def _bwd(scale, causal, interpret, res, grads):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse4, delta4)
+    )(q, k, v, do, lse4, delta4, *seg_args)
     if g > 1:
         dk = dk.reshape(b, hkv, g, skv, d).sum(axis=2).astype(k.dtype)
         dv = dv.reshape(b, hkv, g, skv, d).sum(axis=2).astype(v.dtype)
-    return dq, dk, dv
+    if segmented:
+        import numpy as _np
+        f0 = jax.dtypes.float0
+        return (dq, dk, dv, _np.zeros(seg_q.shape, f0),
+                _np.zeros(seg_kv.shape, f0))
+    return dq, dk, dv, None, None
 
 
 # ---------------------------------------------------------------------------
 # public entry with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, interpret):
-    out, lse4 = _fwd(q, k, v, scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, seg_q, seg_kv, scale, causal, interpret):
+    out, lse4 = _fwd(q, k, v, seg_q, seg_kv, scale, causal, interpret)
     return out, lse4[..., 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret):
-    out, lse4 = _fwd(q, k, v, scale, causal, interpret)
-    return (out, lse4[..., 0]), (q, k, v, out, lse4)
+def _flash_fwd(q, k, v, seg_q, seg_kv, scale, causal, interpret):
+    out, lse4 = _fwd(q, k, v, seg_q, seg_kv, scale, causal, interpret)
+    return (out, lse4[..., 0]), (q, k, v, seg_q, seg_kv, out, lse4)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
@@ -395,8 +462,13 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 def flash_attention_pallas(q, k, v, causal: bool = False,
                            scale: Optional[float] = None,
-                           interpret: bool = False):
-    """(B, S, H, D) flash attention → (out (B,S,H,D), lse (B,H,S))."""
+                           interpret: bool = False, segment_ids=None):
+    """(B, S, H, D) flash attention → (out (B,S,H,D), lse (B,H,S)).
+
+    ``segment_ids``: optional (B, S) int packed-document ids (varlen form,
+    self-attention: the same ids index q and kv); cross-document pairs are
+    masked INSIDE the kernel — packed pretraining batches keep the flash
+    memory profile instead of an O(S²) masked fallback."""
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     if scale is None:
@@ -406,6 +478,11 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     _validate(qt, kt, vt, sq, skv, bq, bk)
-    out, lse = _flash(qt, kt, vt, float(scale), bool(causal),
+    if segment_ids is not None and sq != skv:
+        raise NotImplementedError(
+            "segment_ids assume self-attention (sq == skv)")
+    seg = (None if segment_ids is None
+           else jnp.asarray(segment_ids, jnp.int32))
+    out, lse = _flash(qt, kt, vt, seg, seg, float(scale), bool(causal),
                       bool(interpret))
     return jnp.swapaxes(out, 1, 2), lse
