@@ -150,12 +150,17 @@ class LlamaAttention(Layer):
         q, k = fused_rope(q, k, cos, sin, position_ids)
         return q, k, v
 
-    def forward(self, x, rope_cache, position_ids=None):
+    def forward(self, x, rope_cache, position_ids=None, segment_ids=None):
         c = self.config
         b, s, _ = x.shape
         q, k, v = self._qkv(x, rope_cache, position_ids)
         # heads on mp, batch on (dp, sharding), seq on sep
         if c.context_parallel in ("ring", "ulysses"):
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "packed-sequence segment_ids are not supported under "
+                    "ring/ulysses context parallelism yet — use "
+                    "context_parallel='gspmd'")
             from ..distributed.context_parallel import \
                 context_parallel_attention
             q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
@@ -167,7 +172,8 @@ class LlamaAttention(Layer):
             q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
             k = constrain(k, ("dp", "sharding"), None, "mp", None)
             v = constrain(v, ("dp", "sharding"), None, "mp", None)
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True,
+                                  segment_ids=segment_ids)
         return matmul(out.reshape(b, s, -1), self.o_proj)
 
     def decode(self, x, rope_cache, pos, k_cache, v_cache):
@@ -240,9 +246,9 @@ class LlamaDecoderLayer(Layer):
                                                 dtype=config.dtype)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, rope_cache, position_ids=None):
+    def forward(self, x, rope_cache, position_ids=None, segment_ids=None):
         x = x + self.self_attn(self.input_layernorm(x), rope_cache,
-                               position_ids)
+                               position_ids, segment_ids)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return constrain(x, *_batch_spec(x.ndim))
 
@@ -273,7 +279,12 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", cos)
         self.register_buffer("rope_sin", sin)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, segment_ids=None):
+        """``segment_ids``: optional (B, S) packed-document ids — enables
+        varlen pretraining batches (several documents packed per row with
+        no cross-attention); masking happens inside the flash kernel.
+        Pass matching ``position_ids`` (restarting per document) for the
+        standard packing recipe."""
         c = self.config
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         x = constrain(x, *_batch_spec(x.ndim))
@@ -281,10 +292,11 @@ class LlamaModel(Layer):
         for block in self.layers:
             if c.recompute and self.training:
                 x = jax.checkpoint(
-                    lambda h, blk=block: blk(h, rope, position_ids),
+                    lambda h, blk=block: blk(h, rope, position_ids,
+                                             segment_ids),
                     policy=c.remat_policy)(x)
             else:
-                x = block(x, rope, position_ids)
+                x = block(x, rope, position_ids, segment_ids)
         return self.norm(x)
 
     def decode(self, input_ids, cache, pos):
@@ -335,11 +347,22 @@ class LlamaForCausalLM(Layer):
             return matmul(hidden, w.T)
         return matmul(hidden, self.lm_head)
 
-    def forward(self, input_ids, position_ids=None):
-        return self.logits(self.model(input_ids, position_ids))
+    def forward(self, input_ids, position_ids=None, segment_ids=None):
+        return self.logits(self.model(input_ids, position_ids, segment_ids))
 
-    def compute_loss(self, input_ids, labels, position_ids=None):
-        return causal_lm_loss(self.forward(input_ids, position_ids), labels)
+    def compute_loss(self, input_ids, labels, position_ids=None,
+                     segment_ids=None):
+        if segment_ids is not None:
+            # packed batches: position t where the NEXT token belongs to a
+            # different document would train "predict the next document's
+            # opening token" — attention masking can't prevent that (it is
+            # a label problem, not a leakage problem), so drop those
+            # positions from the loss (-1 = ignored by causal_lm_loss)
+            boundary = segment_ids[:, :-1] != segment_ids[:, 1:]
+            boundary = jnp.pad(boundary, ((0, 0), (0, 1)))
+            labels = jnp.where(boundary, -1, labels)
+        return causal_lm_loss(
+            self.forward(input_ids, position_ids, segment_ids), labels)
 
     def decode_step(self, input_ids, cache, pos):
         """(logits, cache): one cache-carrying decode step (prefill when
