@@ -120,9 +120,9 @@ class ErnieMoEDecoderLayer(Layer):
         else:
             self.mlp = LlamaMLP(c.as_llama())
 
-    def forward(self, x, rope_cache, position_ids=None):
+    def forward(self, x, rope_cache, position_ids=None, segment_ids=None):
         h = x + self.self_attn(self.input_layernorm(x), rope_cache,
-                               position_ids)
+                               position_ids, segment_ids)
         return self._ffn(h, self.post_attention_layernorm(h))
 
     def _ffn(self, h, y):
@@ -159,7 +159,7 @@ class ErnieMoEModel(Layer):
         self.register_buffer("rope_cos", cos)
         self.register_buffer("rope_sin", sin)
 
-    def forward(self, input_ids, position_ids=None
+    def forward(self, input_ids, position_ids=None, segment_ids=None
                 ) -> Tuple[jax.Array, jax.Array]:
         c = self.config
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
@@ -168,7 +168,7 @@ class ErnieMoEModel(Layer):
         aux_total = jnp.zeros((), jnp.float32)
 
         def run(block, h):
-            return block(h, rope, position_ids)
+            return block(h, rope, position_ids, segment_ids)
 
         for block in self.layers:
             if c.recompute and self.training:
@@ -203,13 +203,17 @@ class ErnieMoEForCausalLM(Layer):
             initializer=I.Normal(std=config.initializer_range),
             sharding=P("sharding", "mp"), attr_name="lm_head")
 
-    def forward(self, input_ids, position_ids=None):
-        hidden, aux = self.model(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, segment_ids=None):
+        hidden, aux = self.model(input_ids, position_ids, segment_ids)
         from ..tensor.math import matmul
         return matmul(hidden, self.lm_head), aux
 
-    def compute_loss(self, input_ids, labels, position_ids=None):
-        logits, aux = self.forward(input_ids, position_ids)
+    def compute_loss(self, input_ids, labels, position_ids=None,
+                     segment_ids=None):
+        logits, aux = self.forward(input_ids, position_ids, segment_ids)
+        if segment_ids is not None:
+            from .llama import mask_boundary_labels
+            labels = mask_boundary_labels(labels, segment_ids)
         return causal_lm_loss(logits, labels) + aux
 
     def decode_step(self, input_ids, cache, pos):

@@ -313,6 +313,14 @@ class LlamaModel(Layer):
         return self.norm(x), cache
 
 
+def mask_boundary_labels(labels, segment_ids):
+    """Drop labels at packed-document boundaries: the position whose next
+    token opens ANOTHER document is a packing artifact, not a prediction
+    target (-1 = ignored by :func:`causal_lm_loss`)."""
+    boundary = segment_ids[:, :-1] != segment_ids[:, 1:]
+    return jnp.where(jnp.pad(boundary, ((0, 0), (0, 1))), -1, labels)
+
+
 def causal_lm_loss(logits, labels):
     """Mean next-token cross entropy in fp32 over (possibly vocab-sharded)
     logits — the ParallelCrossEntropy dataflow: no logits all-gather."""
@@ -353,14 +361,9 @@ class LlamaForCausalLM(Layer):
     def compute_loss(self, input_ids, labels, position_ids=None,
                      segment_ids=None):
         if segment_ids is not None:
-            # packed batches: position t where the NEXT token belongs to a
-            # different document would train "predict the next document's
-            # opening token" — attention masking can't prevent that (it is
-            # a label problem, not a leakage problem), so drop those
-            # positions from the loss (-1 = ignored by causal_lm_loss)
-            boundary = segment_ids[:, :-1] != segment_ids[:, 1:]
-            boundary = jnp.pad(boundary, ((0, 0), (0, 1)))
-            labels = jnp.where(boundary, -1, labels)
+            # attention masking can't fix boundary labels — that is a label
+            # problem, not a leakage problem; see mask_boundary_labels
+            labels = mask_boundary_labels(labels, segment_ids)
         return causal_lm_loss(
             self.forward(input_ids, position_ids, segment_ids), labels)
 

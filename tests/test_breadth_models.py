@@ -339,3 +339,30 @@ def test_ddim_sample_denoises_a_trained_target():
     err_before = float(jnp.mean(jnp.abs(before - target)))
     err_after = float(jnp.mean(jnp.abs(after - target)))
     assert err_after < err_before * 0.6, (err_before, err_after)
+
+
+def test_ernie_moe_packed_sequences_match_per_document():
+    """Packing composes with the MoE decoder: packed row == per-document
+    forwards, and boundary labels are dropped from the loss."""
+    pt.seed(51)
+    model = ErnieMoEForCausalLM(tiny_ernie_moe_config(capacity_factor=8.0))
+    model.eval()
+    rng = np.random.RandomState(53)
+    d1, d2 = 9, 7
+    ids = jnp.asarray(rng.randint(0, 256, (1, d1 + d2)), jnp.int32)
+    seg = jnp.asarray([[0] * d1 + [1] * d2], jnp.int32)
+    pos = jnp.asarray([list(range(d1)) + list(range(d2))], jnp.int32)
+    packed, _ = model(ids, position_ids=pos, segment_ids=seg)
+    solo1, _ = model(ids[:, :d1])
+    solo2, _ = model(ids[:, d1:])
+    # generous capacity: routing must agree between packed and solo shapes
+    np.testing.assert_allclose(np.asarray(packed[:, :d1]),
+                               np.asarray(solo1), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(packed[:, d1:]),
+                               np.asarray(solo2), rtol=2e-3, atol=2e-3)
+    labels = jnp.asarray(rng.randint(0, 256, (1, d1 + d2)), jnp.int32)
+    loss = model.compute_loss(ids, labels, position_ids=pos,
+                              segment_ids=seg)
+    want = model.compute_loss(ids, labels.at[0, d1 - 1].set(-1),
+                              position_ids=pos, segment_ids=seg)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-6)
