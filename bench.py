@@ -170,7 +170,20 @@ def main():
     ap.add_argument("--single", action="store_true")
     ap.add_argument("--peak-flops", type=float, default=0.0,
                     dest="peak_flops")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the real-TPU test lane (pytest -m tpu on this "
+                         "chip) instead of the benchmark")
     args = ap.parse_args()
+
+    if args.selftest:
+        # The reference's GPU-CI-lane equivalent: Pallas kernels via Mosaic,
+        # a registry sweep executing every TARGET_SURFACE op on-device, and
+        # train/decode smoke steps.  Run on an idle chip (never concurrently
+        # with the bench — see tests/conftest.py).
+        env = dict(os.environ, PT_TPU_LANE="1")
+        raise SystemExit(subprocess.call(
+            [sys.executable, "-m", "pytest", "tests/", "-m", "tpu", "-q"],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__))))
 
     if args.single:
         run_single(args)

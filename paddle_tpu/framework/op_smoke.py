@@ -1,0 +1,386 @@
+"""Tiny-shape smoke invocations for every TARGET_SURFACE op.
+
+The round-3 verdict's core finding: every CI test ran on the fake CPU mesh,
+so an op that only breaks on the real chip (``eig``: no TPU lowering) stayed
+"implemented" in the registry while crashing in users' hands.  This module
+is the antidote — for each name in
+:mod:`paddle_tpu.framework.op_registry`'s TARGET_SURFACE it records one
+concrete tiny-shape call, so the TPU lane (``PT_TPU_LANE=1 pytest -m tpu``)
+can execute the whole surface on-device.  The reference's equivalent is its
+per-op OpTest grid running in the GPU CI lane (SURVEY §4 op-unit-tests +
+CI-driver rows); numerical semantics are covered by the CPU-lane OpTests —
+this sweep only asserts "compiles and executes on the chip".
+
+Shapes are deliberately tiny (≤ 4×4-ish): the point is lowering coverage,
+not perf; the bench owns perf.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import op_registry
+
+# ---------------------------------------------------------------------------
+# canonical tiny inputs (built lazily so importing this module stays cheap
+# and never touches a backend)
+# ---------------------------------------------------------------------------
+
+
+def _inputs() -> Dict[str, Any]:
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 3)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(2, 3)), jnp.float32)
+    m = jnp.asarray(rng.normal(size=(3, 3)) + 3.0 * np.eye(3), jnp.float32)
+    spd = m @ m.T + 3.0 * jnp.eye(3)
+    tri = jnp.triu(m) + 2.0 * jnp.eye(3)
+    v = jnp.asarray([0.3, -1.2, 2.1], jnp.float32)
+    vs = jnp.asarray([-2.0, -0.5, 0.5, 2.0], jnp.float32)  # sorted
+    unit = jnp.asarray(rng.uniform(0.05, 0.95, size=(2, 3)), jnp.float32)
+    pos = jnp.abs(x) + 0.5
+    b3 = jnp.asarray(rng.normal(size=(2, 3, 4)), jnp.float32)
+    b3t = jnp.asarray(rng.normal(size=(2, 4, 3)), jnp.float32)
+    img = jnp.asarray(rng.normal(size=(1, 4, 4, 4)), jnp.float32)  # NCHW
+    ids = jnp.asarray([[1, 4, 2], [0, 3, 5]], jnp.int32)
+    iarr = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)  # B,S,H,D
+    return dict(x=x, y=y, m=m, spd=spd, tri=tri, v=v, vs=vs, unit=unit,
+                pos=pos, b3=b3, b3t=b3t, img=img, ids=ids, iarr=iarr,
+                q=q, rng=rng)
+
+
+# categories whose default pattern is f(x) on the 2×3 float array
+_UNARY_DEFAULT = {"paddle.math", "paddle.logic"}
+# math/logic ops that take (x, y)
+_BINARY = {
+    "add", "atan2", "divide", "fmax", "fmin", "heaviside", "maximum",
+    "minimum", "multiply", "pow", "subtract",
+    "allclose", "equal", "equal_all", "greater_equal", "greater_than",
+    "isclose", "less_equal", "less_than", "logical_and", "logical_or",
+    "logical_xor", "not_equal",
+}
+# math ops needing strictly-positive / unit-interval / special domains
+_DOMAIN = {
+    "acos": "unit", "asin": "unit", "atanh": "unit", "erfinv": "unit",
+    "logit": "unit", "acosh": "pos1", "digamma": "pos", "lgamma": "pos",
+    "log": "pos", "log10": "pos", "log1p": "pos", "log2": "pos",
+    "rsqrt": "pos", "sqrt": "pos", "reciprocal": "pos",
+}
+
+
+def smoke_cases() -> Dict[str, Callable[[], Any]]:
+    """'category:name' → zero-arg thunk running one tiny-shape call.
+
+    Thunks re-resolve the implementing callable at run time (through
+    op_registry.resolve), so a regressed op fails here rather than being
+    silently skipped.
+    """
+    I = _inputs()
+    x, y, m = I["x"], I["y"], I["m"]
+    spd, tri, v, vs = I["spd"], I["tri"], I["v"], I["vs"]
+    unit, pos, b3, b3t = I["unit"], I["pos"], I["b3"], I["b3t"]
+    img, ids, iarr, q = I["img"], I["ids"], I["iarr"], I["q"]
+    idx = jnp.asarray([0, 1], jnp.int32)
+
+    # hand-written calls for everything that is not plain f(x) / f(x, y)
+    special: Dict[str, Callable[[Callable], Any]] = {
+        # creation
+        "arange": lambda f: f(0, 6, 1),
+        "diag": lambda f: f(v),
+        "diagflat": lambda f: f(v),
+        "empty": lambda f: f([2, 3]),
+        "eye": lambda f: f(3),
+        "full": lambda f: f([2, 2], 1.5),
+        "full_like": lambda f: f(x, 2.0),
+        "linspace": lambda f: f(0.0, 1.0, 5),
+        "logspace": lambda f: f(0.0, 1.0, 5),
+        "meshgrid": lambda f: f(v, v),
+        "ones": lambda f: f([2, 2]),
+        "to_tensor": lambda f: f([[1.0, 2.0]]),
+        "tril": lambda f: f(m),
+        "triu": lambda f: f(m),
+        "zeros": lambda f: f([2, 2]),
+        # manipulation
+        "as_strided": lambda f: f(x, [2, 2], [3, 1]),
+        "broadcast_to": lambda f: f(x, [2, 2, 3]),
+        "cast": lambda f: f(x, "float16"),
+        "chunk": lambda f: f(x, 3, 1),
+        "concat": lambda f: f([x, y], 0),
+        "expand": lambda f: f(x, [2, 2, 3]),
+        "expand_as": lambda f: f(x, jnp.zeros((2, 2, 3))),
+        "flip": lambda f: f(x, 0),
+        "gather": lambda f: f(x, idx, 0),
+        "gather_nd": lambda f: f(x, jnp.asarray([[0, 1], [1, 2]], jnp.int32)),
+        "index_select": lambda f: f(x, idx, 1),
+        "masked_select": lambda f: f(x, x > 0),
+        "moveaxis": lambda f: f(x, 0, 1),
+        "put_along_axis": lambda f: f(
+            x, jnp.asarray([[0], [1]], jnp.int32),
+            jnp.asarray([[9.0], [8.0]], jnp.float32), 1),
+        "repeat_interleave": lambda f: f(x, 2, 1),
+        "reshape": lambda f: f(x, [3, 2]),
+        "roll": lambda f: f(x, 1, 0),
+        "rot90": lambda f: f(x),
+        "scatter": lambda f: f(x, idx, y),
+        "scatter_nd_add": lambda f: f(
+            x, jnp.asarray([[0, 1], [1, 2]], jnp.int32),
+            jnp.asarray([1.0, 2.0], jnp.float32)),
+        "slice": lambda f: f(x, [0], [0], [1]),
+        "split": lambda f: f(x, 3, 1),
+        "squeeze": lambda f: f(x[:, None]),
+        "stack": lambda f: f([x, y], 0),
+        "strided_slice": lambda f: f(x, [1], [0], [3], [2]),
+        "take_along_axis": lambda f: f(
+            x, jnp.asarray([[0], [2]], jnp.int32), 1),
+        "tile": lambda f: f(x, [2, 1]),
+        "transpose": lambda f: f(x, [1, 0]),
+        "unbind": lambda f: f(x, 0),
+        "unique": lambda f: f(jnp.asarray([1, 2, 2, 3])),
+        "unsqueeze": lambda f: f(x, 0),
+        "unstack": lambda f: f(x, 0),
+        "view": lambda f: f(x, [3, 2]),
+        # math (non-unary/non-binary)
+        "add_n": lambda f: f([x, y]),
+        "bmm": lambda f: f(b3, b3t),
+        "clip": lambda f: f(x, -1.0, 1.0),
+        "cross": lambda f: f(x, y),
+        "cumprod": lambda f: f(x, 0),
+        "dot": lambda f: f(v, v),
+        "einsum": lambda f: f("ij,jk->ik", m, m),
+        "floor_divide": lambda f: f(pos, jnp.abs(y) + 1.0),
+        "inner": lambda f: f(v, v),
+        "lerp": lambda f: f(x, y, 0.5),
+        "logit": lambda f: f(unit, 1e-6),
+        "matmul": lambda f: f(m, m),
+        "mm": lambda f: f(m, m),
+        "mod": lambda f: f(pos, jnp.abs(y) + 1.0),
+        "mv": lambda f: f(m, v),
+        "outer": lambda f: f(v, v),
+        "remainder": lambda f: f(pos, jnp.abs(y) + 1.0),
+        "trace": lambda f: f(m),
+        "trapezoid": lambda f: f(v),
+        "vander": lambda f: f(v),
+        # logic
+        "bitwise_and": lambda f: f(iarr, iarr),
+        "bitwise_not": lambda f: f(iarr),
+        "bitwise_or": lambda f: f(iarr, iarr),
+        "bitwise_xor": lambda f: f(iarr, iarr),
+        "where": lambda f: f(x > 0, x, y),
+        # search
+        "bucketize": lambda f: f(x, vs),
+        "histogram": lambda f: f(x, 4, -3.0, 3.0),
+        "index_sample": lambda f: f(x, jnp.asarray([[0, 1], [2, 0]],
+                                                   jnp.int32)),
+        "kthvalue": lambda f: f(x, 2),
+        "masked_fill": lambda f: f(x, x > 0, 0.0),
+        "quantile": lambda f: f(x, 0.5),
+        "searchsorted": lambda f: f(vs, x),
+        "topk": lambda f: f(x, 2),
+        # random
+        "bernoulli": lambda f: f(unit),
+        "exponential": lambda f: f(pos),
+        "multinomial": lambda f: f(unit[0], 2, True),
+        "normal": lambda f: f(0.0, 1.0, (2, 2)),
+        "poisson": lambda f: f(pos),
+        "rand": lambda f: f([2, 2]),
+        "randint": lambda f: f(0, 5, [3]),
+        "randn": lambda f: f([2, 2]),
+        "randperm": lambda f: f(5),
+        "shuffle": lambda f: f(x),
+        "standard_normal": lambda f: f([2, 2]),
+        "uniform": lambda f: f([2, 2]),
+        # linalg
+        "cholesky": lambda f: f(spd),
+        "cholesky_solve": lambda f: f(
+            jnp.ones((3, 1), jnp.float32), jnp.linalg.cholesky(spd)),
+        "cond": lambda f: f(m),
+        "det": lambda f: f(m),
+        "dist": lambda f: f(x, y),
+        "eig": lambda f: f(m),
+        "eigh": lambda f: f(spd),
+        "eigvals": lambda f: f(m),
+        "eigvalsh": lambda f: f(spd),
+        "householder_product": lambda f: f(
+            m, jnp.asarray([0.5, 0.3, 0.1], jnp.float32)),
+        "inv": lambda f: f(m),
+        "lstsq": lambda f: f(m, jnp.ones((3, 1), jnp.float32)),
+        "lu": lambda f: f(m),
+        "matrix_power": lambda f: f(m, 2),
+        "matrix_rank": lambda f: f(m),
+        "matrix_transpose": lambda f: f(m),
+        "multi_dot": lambda f: f([m, m]),
+        "pinv": lambda f: f(m),
+        "qr": lambda f: f(m),
+        "slogdet": lambda f: f(m),
+        "solve": lambda f: f(m, jnp.ones((3,), jnp.float32)),
+        "svd": lambda f: f(m),
+        "triangular_solve": lambda f: f(tri, jnp.ones((3, 1), jnp.float32)),
+        # nn.functional
+        "avg_pool2d": lambda f: f(img, 2),
+        "conv2d": lambda f: f(img, jnp.ones((3, 4, 2, 2), jnp.float32) * 0.1),
+        "cross_entropy": lambda f: f(
+            jnp.asarray(np.random.default_rng(1).normal(size=(4, 5)),
+                        jnp.float32),
+            jnp.asarray([0, 1, 2, 3], jnp.int64)),
+        "dropout": lambda f: f(x, 0.5),
+        "embedding": lambda f: f(ids, jnp.ones((10, 4), jnp.float32)),
+        "group_norm": lambda f: f(img, 2),
+        "interpolate": lambda f: f(img, None, 2),
+        "layer_norm": lambda f: f(x, [3]),
+        "linear": lambda f: f(x, jnp.ones((3, 4), jnp.float32),
+                              jnp.zeros((4,), jnp.float32)),
+        "max_pool2d": lambda f: f(img, 2),
+        "mse_loss": lambda f: f(x, y),
+        "one_hot": lambda f: f(ids, 10),
+        "pad": lambda f: f(x, [1, 1]),
+        "prelu": lambda f: f(x, jnp.asarray([0.2], jnp.float32)),
+        "scaled_dot_product_attention": lambda f: f(q, q, q),
+        "smooth_l1_loss": lambda f: f(x, y),
+        "softmax_with_cross_entropy": lambda f: f(
+            jnp.asarray(np.random.default_rng(1).normal(size=(4, 5)),
+                        jnp.float32),
+            jnp.asarray([[0], [1], [2], [3]], jnp.int64)),
+        "swiglu": lambda f: f(x, y),
+        "unfold": lambda f: f(img, 2),
+        # incubate
+        "flash_attention": lambda f: f(q, q, q, causal=True),
+        "fused_rms_norm": lambda f: f(x),
+        "fused_rotary_position_embedding": lambda f: _rope_case(f),
+        "ring_attention": lambda f: _ring_case(f),
+        "ssd_scan": lambda f: f(
+            jnp.ones((1, 4, 2, 4), jnp.float32),          # x (B,L,H,P)
+            jnp.full((1, 4, 2), 0.9, jnp.float32),        # a (B,L,H)
+            jnp.ones((1, 4, 1, 4), jnp.float32) * 0.1,    # b (B,L,G,N)
+            jnp.ones((1, 4, 1, 4), jnp.float32) * 0.1),   # c
+        "wkv": lambda f: f(
+            jnp.asarray([0.1, 0.2], jnp.float32),
+            jnp.asarray([0.3, 0.1], jnp.float32),
+            jnp.ones((1, 4, 2), jnp.float32) * 0.1,
+            jnp.ones((1, 4, 2), jnp.float32)),
+    }
+
+    cases: Dict[str, Callable[[], Any]] = {}
+    for cat, names in op_registry.TARGET_SURFACE.items():
+        for name in names:
+            cases[f"{cat}:{name}"] = _make_thunk(cat, name, special,
+                                                 x, y, unit, pos, idx)
+    return cases
+
+
+def _rope_case(f):
+    from ..ops.rope import build_rope_cache
+    q = jnp.ones((1, 4, 2, 8), jnp.float32)
+    cos, sin = build_rope_cache(4, 8)
+    return f(q, q, cos, sin)
+
+
+def _single_device_group():
+    """An AxisGroup over a 1-device mesh of the default backend — collective
+    semantics at world size 1, which is what one bench chip gives us."""
+    from jax.sharding import Mesh
+    from ..distributed.collective import AxisGroup
+    devs = np.asarray(jax.devices()[:1])
+    mesh = Mesh(devs, ("x",))
+    return AxisGroup("x", mesh), mesh
+
+
+def _ring_case(f):
+    from jax.sharding import Mesh
+    devs = np.asarray(jax.devices()[:1])
+    mesh = Mesh(devs, ("sep",))
+    q = jnp.ones((1, 8, 2, 16), jnp.float32)
+    return f(q, q, q, causal=True, mesh=mesh)
+
+
+def _collective_thunk(name: str, fn, x):
+    group, mesh = _single_device_group()
+    if name == "barrier":
+        return fn(group)
+    if name in ("send", "isend"):
+        return fn(x, 0, 0, group)
+    if name in ("recv", "irecv"):
+        return fn(x, 0, 0, group)
+    return fn(x, group=group)
+
+
+def _optimizer_thunk(name: str, fn, x):
+    if name == "Optimizer":  # abstract base: constructing it is the smoke
+        return fn(learning_rate=0.1)
+    o = fn(learning_rate=0.1) if name != "Lamb" else fn(0.1)
+    p = {"w": x}
+    s = o.init(p)
+    new_p, s = o.update({"w": jnp.ones_like(x)}, s, p)
+    return new_p
+
+
+def _lr_thunk(name: str, fn):
+    kwargs = {
+        "ConstantLR": dict(learning_rate=0.1),
+        "LRScheduler": dict(learning_rate=0.1),
+        "CosineAnnealingDecay": dict(learning_rate=0.1, T_max=10),
+        "ExponentialDecay": dict(learning_rate=0.1, gamma=0.9),
+        "LinearWarmup": dict(learning_rate=0.1, warmup_steps=5),
+        "MultiStepDecay": dict(learning_rate=0.1, milestones=[2, 4]),
+        "NoamDecay": dict(d_model=8, warmup_steps=5),
+        "PolynomialDecay": dict(learning_rate=0.1, decay_steps=5),
+        "StepDecay": dict(learning_rate=0.1, step_size=2),
+    }[name]
+    sched = fn(**kwargs)
+    if name == "LRScheduler":  # abstract base: get_lr is subclass-provided
+        return sched
+    sched.step()
+    return sched.get_lr()
+
+
+def _make_thunk(cat: str, name: str, special, x, y, unit, pos, idx):
+    def thunk():
+        table = op_registry.resolve()[cat]
+        fn = table.get(name)
+        if fn is None:
+            raise RuntimeError(f"{cat}:{name} not implemented (registry)")
+        if cat == "paddle.distributed":
+            out = _collective_thunk(name, fn, x)
+        elif cat == "paddle.optimizer":
+            out = _optimizer_thunk(name, fn, x)
+        elif cat == "paddle.optimizer.lr":
+            out = _lr_thunk(name, fn)
+        elif name in special:
+            out = special[name](fn)
+        elif name in _BINARY:
+            out = fn(x, y)
+        else:
+            dom = _DOMAIN.get(name)
+            arg = {None: x, "unit": unit, "pos": pos,
+                   "pos1": pos + 1.0}[dom]
+            out = fn(arg)
+        # force execution (lowering bugs surface at run, not trace, time)
+        for leaf in jax.tree_util.tree_leaves(out):
+            if isinstance(leaf, jax.Array):
+                jax.block_until_ready(leaf)
+        return out
+    return thunk
+
+
+def run(names: Optional[List[str]] = None) -> Dict[str, str]:
+    """Run all (or the named) smoke cases; return {case: error} failures."""
+    cases = smoke_cases()
+    failures: Dict[str, str] = {}
+    for key, thunk in cases.items():
+        if names is not None and key not in names:
+            continue
+        try:
+            thunk()
+        except Exception as e:  # noqa: BLE001 — report, don't mask, per-op
+            failures[key] = f"{type(e).__name__}: {e}"
+    return failures
+
+
+if __name__ == "__main__":
+    fails = run()
+    print(f"{len(smoke_cases()) - len(fails)} ok, {len(fails)} failed")
+    for k, v in sorted(fails.items()):
+        print(f"  FAIL {k}: {v[:200]}")

@@ -226,9 +226,19 @@ def cross_entropy(logits, labels, ignore_index: int = -100,
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
-    return cross_entropy(logits, label, reduction="none",
+    """Parity: paddle's hard-label convention keeps the class axis in the
+    label with extent 1 ((N, 1) ids, loss returned as (N, 1)); soft labels
+    are full distributions over ``axis``.  Found by the TPU-lane op sweep."""
+    squeeze = (not soft_label and label.ndim == logits.ndim
+               and label.shape[axis] == 1)
+    if squeeze:
+        label = jnp.squeeze(label, axis)
+    loss = cross_entropy(logits, label, reduction="none",
                          soft_label=soft_label, axis=axis,
                          ignore_index=-100)
+    if squeeze:
+        loss = jnp.expand_dims(loss, axis)
+    return loss
 
 
 def mse_loss(input, label, reduction: str = "mean"):
