@@ -234,6 +234,27 @@ def broadcast(x, src: int = 0, group=None):
 
 # -- point-to-point ----------------------------------------------------------
 
+def _superset_note(name: str) -> None:
+    """One-shot VLOG on first use of a primitive whose delivery deviates
+    from paddle's rooted/P2P contract (round-3 advisor): rooted collectives
+    deliver to every rank, not just dst; send/recv zero non-participating
+    ranks instead of leaving their tensors untouched.  Reference code ported
+    verbatim that RELIES on non-root tensors being unchanged must be
+    adapted; the log makes the first such call visible instead of silent."""
+    from ..utils.logging import vlog_once
+
+    notes = {
+        "reduce": "delivers the reduced value to EVERY rank (paddle: dst "
+                  "only)",
+        "gather": "delivers the concatenation to EVERY rank (paddle: dst "
+                  "only)",
+        "send/recv": "non-participating ranks receive ZEROS (paddle: their "
+                     "tensors are left untouched)",
+    }
+    vlog_once(1, f"collective:superset:{name}",
+              f"paddle.distributed.{name}: GSPMD lowering {notes[name]}")
+
+
 def ppermute(x, perm: Sequence[Tuple[int, int]], group=None):
     """Collective permute (parity: batch_isend_irecv / P2POp lists —
     the reference's pipeline p2p layer; on TPU a single collective-permute
@@ -285,12 +306,14 @@ def send(x, dst: int, src: int, group=None):
     :func:`send_next`/:func:`recv_prev` (a single fused collective-permute
     around the ring) instead of per-pair calls.
     """
+    _superset_note("send/recv")
     return ppermute(x, [(src, dst)], group)
 
 
 def recv(x, src: int, dst: int, group=None):
     """P2P receive — the matching half of :func:`send` (same lowering;
     ``dst`` is REQUIRED for the same static-pair reason)."""
+    _superset_note("send/recv")
     return ppermute(x, [(src, dst)], group)
 
 
@@ -315,6 +338,7 @@ def reduce(x, dst: int = 0, op: str = ReduceOp.SUM, group=None):
     of the reference's dst-only contract.
     """
     del dst
+    _superset_note("reduce")
     return all_reduce(x, op=op, group=group)
 
 
@@ -323,6 +347,7 @@ def gather(x, dst: int = 0, axis: int = 0, group=None):
     gets the concatenation (superset of dst-only delivery, as with
     :func:`reduce`); shard i lands at position i along ``axis``."""
     del dst
+    _superset_note("gather")
     return all_gather(x, axis=axis, group=group, tiled=False)
 
 

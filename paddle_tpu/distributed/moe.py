@@ -54,6 +54,12 @@ __all__ = ["Gate", "SwitchGate", "GShardGate", "MoELayer"]
 
 EP_AXES = ("dp", "sharding")  # expert dim rides the combined dp×sharding axes
 
+# Eval calls with tokens·top_k ≤ this many slots per expert get a no-drop
+# capacity (see MoELayer._capacity); larger eval batches keep the
+# factor-based capacity, so the decode-parity guarantee is scoped to
+# decode-shaped batches.
+EVAL_NO_DROP_SLOTS = 64
+
 
 class Gate(Layer):
     """Router base (parity: BaseGate).  Subclasses set ``top_k``."""
@@ -141,8 +147,23 @@ class MoELayer(Layer):
     def _capacity(self, tokens: int) -> int:
         f = (self.capacity_factor if self.training
              else self.eval_capacity_factor)
-        return max(4, int(math.ceil(tokens * self.top_k * f
-                                    / self.num_experts)))
+        c = max(4, int(math.ceil(tokens * self.top_k * f
+                                 / self.num_experts)))
+        if (not self.training
+                and tokens * self.top_k <= EVAL_NO_DROP_SLOTS
+                * self.num_experts):
+            # Decode-shaped eval calls (T = batch at single-token steps)
+            # recompute capacity from the tiny T, so capacity-based dropping
+            # would differ from the prefill/full-forward routing of the same
+            # tokens (round-3 advisor).  For these small shapes a no-drop
+            # capacity (C >= T·k even if every token picks one expert) costs
+            # almost nothing, so greedy-decode parity does not hinge on a
+            # generous eval_capacity_factor.  Big eval forwards (and decode
+            # batches past the EVAL_NO_DROP_SLOTS threshold) keep the
+            # factor-based capacity — no-drop there would blow up the
+            # (E, C, …) dispatch buffers.
+            c = max(c, tokens * self.top_k)
+        return c
 
     def _topk_choices(self, logits):
         """Shared routing core.  (T, E) logits → per-choice lists

@@ -331,8 +331,8 @@ class PipelineParallel:
             if c > 0:
                 cot[m] = dx
 
-        # copy: schedule_ops is lru_cached and last_ops is advertised to
-        # external consumers — aliasing would let them corrupt the cache
+        # schedule_ops returns an immutable tuple; materialise the list
+        # form last_ops is documented to expose
         self.last_ops = list(schedule_ops(self.layers.num_stages,
                                           self.layers.num_virtual_stages, M,
                                           self.schedule))

@@ -55,26 +55,28 @@ def _deps(op: Op, n_chunks: int) -> List[Op]:
 
 @functools.lru_cache(maxsize=64)
 def schedule_ops(num_stages: int, num_virtual: int, num_micro: int,
-                 schedule: str = "1F1B") -> List[Op]:
+                 schedule: str = "1F1B") -> Tuple[Op, ...]:
     """Global enqueue order for S stages × V virtual chunks × M microbatches.
 
     Cached: the greedy generator is O(ops²) pure Python (~hundreds of ms at
     S=8, V=2, M=32) and its inputs are fixed for a trainer's lifetime —
     without the cache that cost would serialize ahead of every
-    train_batch's async dispatch.  Callers must not mutate the result."""
+    train_batch's async dispatch.  Returns a tuple so the cached value is
+    immutable — a caller mutating a cached list would silently corrupt
+    every later schedule with the same key (round-3 advisor)."""
     S, V, M = num_stages, num_virtual, num_micro
     C = S * V
     if schedule == "FThenB":
         ops = [("fwd", c, m) for m in range(M) for c in range(C)]
         ops += [("bwd", c, m) for m in range(M) for c in reversed(range(C))]
-        return ops
+        return tuple(ops)
     if schedule != "1F1B":
         raise ValueError(f"unknown schedule {schedule!r}")
     # greedy for every V, including 1: a single global queue that walks each
     # microbatch depth-first (the naive translation of the reference's
     # rank-local 1F1B loop) head-of-line-blocks later stages — measured
     # bubble 0.467 vs 0.111 for the greedy order at S=2, M=8, bwd=2·fwd
-    return _greedy_interleave(S, V, M)
+    return tuple(_greedy_interleave(S, V, M))
 
 
 def _greedy_interleave(S: int, V: int, M: int,

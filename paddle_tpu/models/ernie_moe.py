@@ -218,7 +218,15 @@ class ErnieMoEForCausalLM(Layer):
 
     def decode_step(self, input_ids, cache, pos):
         """(logits, cache) — the generation hook (router aux losses are a
-        training quantity and are dropped at decode time)."""
+        training quantity and are dropped at decode time).
+
+        MoE routing note: expert capacity is recomputed per call from the
+        token count, and decode steps see T = batch; eval-mode capacity is
+        no-drop while batch·top_k ≤ ``moe.EVAL_NO_DROP_SLOTS``·num_experts
+        (see ``MoELayer._capacity``), so for decode-shaped batches routing
+        never drops a token that a full forward would keep.  Decode batches
+        past that threshold fall back to the factor-based capacity — size
+        ``eval_capacity_factor`` accordingly."""
         hidden, cache = self.model.decode(input_ids, cache, pos)
         from ..tensor.math import matmul
         return matmul(hidden, self.lm_head), cache
