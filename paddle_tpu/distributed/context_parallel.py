@@ -39,12 +39,15 @@ def _fallback(reason: str):
 def context_parallel_attention(q, k, v, causal: bool = True,
                                scale: Optional[float] = None,
                                mode: str = "ring", axis: str = "sep",
-                               mesh=None):
+                               mesh=None, segment_ids=None):
     """Attention over seq-sharded activations.
 
     q: (B, S, Hq, D), k/v: (B, S, Hkv, D) with S the *global* sequence,
     sharded over ``axis`` by the caller's constraints.  mode: "ring" |
-    "ulysses".  Returns out (B, S, Hq, D), seq-sharded the same way.
+    "ulysses".  ``segment_ids``: optional (B, S) packed-document ids,
+    sharded over ``axis`` like the sequence (the varlen × CP composition —
+    SURVEY §5 long-context row).  Returns out (B, S, Hq, D), seq-sharded
+    the same way.
     """
     if mode not in ("ring", "ulysses"):
         raise ValueError(f"mode must be 'ring' or 'ulysses', got {mode!r}")
@@ -53,7 +56,8 @@ def context_parallel_attention(q, k, v, causal: bool = True,
         _fallback("no active mesh" if m is None
                   else f"mesh has no {axis!r} axis" if axis not in m.axis_names
                   else f"{axis!r} degree is 1")
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               segment_ids=segment_ids)
     shard_fn = (ring_attention_shard if mode == "ring"
                 else ulysses_attention_shard)
     batch_axes = tuple(a for a in ("dp", "sharding") if a in m.axis_names)
@@ -61,12 +65,22 @@ def context_parallel_attention(q, k, v, causal: bool = True,
     h_spec = "mp" if "mp" in m.axis_names else None
     qkv_spec = P(b_spec, axis, h_spec, None)
     lse_spec = P(b_spec, h_spec, axis)
+    seg_spec = P(b_spec, axis)
 
-    fn = jax.shard_map(
-        lambda q_, k_, v_: shard_fn(q_, k_, v_, axis, causal=causal,
-                                    scale=scale),
-        mesh=m,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=(qkv_spec, lse_spec))
-    out, _ = fn(q, k, v)
+    if segment_ids is None:
+        fn = jax.shard_map(
+            lambda q_, k_, v_: shard_fn(q_, k_, v_, axis, causal=causal,
+                                        scale=scale),
+            mesh=m,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=(qkv_spec, lse_spec))
+        out, _ = fn(q, k, v)
+    else:
+        fn = jax.shard_map(
+            lambda q_, k_, v_, s_: shard_fn(q_, k_, v_, axis, causal=causal,
+                                            scale=scale, segment_ids=s_),
+            mesh=m,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+            out_specs=(qkv_spec, lse_spec))
+        out, _ = fn(q, k, v, segment_ids)
     return out

@@ -156,18 +156,17 @@ class LlamaAttention(Layer):
         q, k, v = self._qkv(x, rope_cache, position_ids)
         # heads on mp, batch on (dp, sharding), seq on sep
         if c.context_parallel in ("ring", "ulysses"):
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "packed-sequence segment_ids are not supported under "
-                    "ring/ulysses context parallelism yet — use "
-                    "context_parallel='gspmd'")
             from ..distributed.context_parallel import \
                 context_parallel_attention
             q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
             k = constrain(k, ("dp", "sharding"), "sep", "mp", None)
             v = constrain(v, ("dp", "sharding"), "sep", "mp", None)
+            if segment_ids is not None:
+                segment_ids = constrain(segment_ids, ("dp", "sharding"),
+                                        "sep")
             out = context_parallel_attention(q, k, v, causal=True,
-                                             mode=c.context_parallel)
+                                             mode=c.context_parallel,
+                                             segment_ids=segment_ids)
         else:
             q = constrain(q, ("dp", "sharding"), "sep", "mp", None)
             k = constrain(k, ("dp", "sharding"), None, "mp", None)

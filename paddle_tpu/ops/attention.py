@@ -129,25 +129,35 @@ def segment_mask(q_segment_ids, kv_segment_ids):
 
 def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                     causal: bool = False, scale: Optional[float] = None,
-                    return_lse: bool = False, segment_ids=None):
+                    return_lse: bool = False, segment_ids=None,
+                    kv_segment_ids=None):
     """Public entry (parity: ``paddle.nn.functional.flash_attention``).
 
     Dispatches to the Pallas blocked kernel on TPU when the shape/feature set
     is eligible (no dropout, no custom mask — same restrictions as the
     reference's flash path, which falls back to the math path otherwise).
 
-    ``segment_ids``: (B, S) ints marking packed-document membership (the
+    ``segment_ids``: (B, Sq) ints marking packed-document membership (the
     varlen form); cross-document attention is masked out.  On the Pallas
     path the mask lives INSIDE the kernel (segment blocks ride the grid),
     keeping the flash memory profile for packed pretraining batches; the
     XLA fallback materialises the (B, 1, S, S) mask — measured on v5e at
     B=4, S=4096, H=8: 67 MB of temp HBM for the kernel vs 2.15 GB for the
     masked path (XLA memory_analysis).
+
+    ``kv_segment_ids``: (B, Skv) ids for keys that are not the queries' own
+    positions — ring attention's visiting KV blocks (SURVEY §5 long-context
+    row: varlen × context parallelism).  Defaults to ``segment_ids``.
     """
-    if segment_ids is not None and q.shape[1] != k.shape[1]:
+    if (segment_ids is not None and kv_segment_ids is None
+            and q.shape[1] != k.shape[1]):
         raise ValueError(
-            "segment_ids assume self-attention (q and kv share positions); "
-            f"got sq={q.shape[1]}, skv={k.shape[1]}")
+            "segment_ids without kv_segment_ids assume self-attention "
+            f"(q and kv share positions); got sq={q.shape[1]}, "
+            f"skv={k.shape[1]} — pass kv_segment_ids for cross-slice "
+            "attention")
+    if kv_segment_ids is not None and segment_ids is None:
+        raise ValueError("kv_segment_ids requires segment_ids")
     if not _dispatch.use_pallas():
         _fallback("no Pallas-capable backend "
                   f"({_dispatch.default_backend()})", warn=False)
@@ -165,13 +175,16 @@ def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                 out, lse = flash_attention_pallas(
                     q, k, v, causal=causal, scale=scale,
                     interpret=_dispatch.pallas_interpret(),
-                    segment_ids=segment_ids)
+                    segment_ids=segment_ids,
+                    kv_segment_ids=kv_segment_ids)
                 return (out, lse) if return_lse else out
             except NotImplementedError as e:
                 reason = str(e)
         _fallback(reason)
     if segment_ids is not None:
-        seg = segment_mask(segment_ids, segment_ids)
+        seg = segment_mask(segment_ids,
+                           segment_ids if kv_segment_ids is None
+                           else kv_segment_ids)
         if attn_mask is None:
             attn_mask = seg
         elif attn_mask.dtype == jnp.bool_:

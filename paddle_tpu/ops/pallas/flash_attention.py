@@ -462,13 +462,17 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 def flash_attention_pallas(q, k, v, causal: bool = False,
                            scale: Optional[float] = None,
-                           interpret: bool = False, segment_ids=None):
+                           interpret: bool = False, segment_ids=None,
+                           kv_segment_ids=None):
     """(B, S, H, D) flash attention → (out (B,S,H,D), lse (B,H,S)).
 
-    ``segment_ids``: optional (B, S) int packed-document ids (varlen form,
-    self-attention: the same ids index q and kv); cross-document pairs are
-    masked INSIDE the kernel — packed pretraining batches keep the flash
-    memory profile instead of an O(S²) masked fallback."""
+    ``segment_ids``: optional (B, Sq) int packed-document ids (varlen
+    form); cross-document pairs are masked INSIDE the kernel — packed
+    pretraining batches keep the flash memory profile instead of an O(S²)
+    masked fallback.  ``kv_segment_ids``: optional (B, Skv) ids for the
+    keys when they are NOT the queries' own positions — the ring-attention
+    case, where each hop attends a visiting KV block from another rank's
+    sequence slice; defaults to ``segment_ids`` (self-attention)."""
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     if scale is None:
@@ -478,11 +482,16 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     _validate(qt, kt, vt, sq, skv, bq, bk)
-    if segment_ids is not None and sq != skv:
+    if segment_ids is not None and kv_segment_ids is None and sq != skv:
         raise NotImplementedError(
-            "segment_ids assume self-attention (sq == skv)")
-    seg = (None if segment_ids is None
-           else jnp.asarray(segment_ids, jnp.int32))
-    out, lse = _flash(qt, kt, vt, seg, seg, float(scale), bool(causal),
+            "segment_ids without kv_segment_ids assume self-attention "
+            "(sq == skv); pass kv_segment_ids for cross-slice attention")
+    seg_q = (None if segment_ids is None
+             else jnp.asarray(segment_ids, jnp.int32))
+    seg_kv = (seg_q if kv_segment_ids is None
+              else jnp.asarray(kv_segment_ids, jnp.int32))
+    if seg_q is None and seg_kv is not None:
+        raise ValueError("kv_segment_ids requires segment_ids")
+    out, lse = _flash(qt, kt, vt, seg_q, seg_kv, float(scale), bool(causal),
                       bool(interpret))
     return jnp.swapaxes(out, 1, 2), lse

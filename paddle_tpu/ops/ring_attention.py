@@ -70,9 +70,15 @@ def _as_varying(x, like, axis_name):
     return lax.pcast(x, missing, to="varying") if missing else x
 
 
-def _block(q, k, v, mode, scale, axis_name):
+def _block(q, k, v, mode, scale, axis_name, seg_q=None, seg_kv=None):
     """One Q-block × KV-block attention partial.  mode: 0=skip, 1=full,
-    2=causal-diagonal.  Returns (out, lse)."""
+    2=causal-diagonal.  Returns (out, lse).
+
+    ``seg_q``/``seg_kv``: packed-document ids of the local queries and of
+    the *visiting* KV block (they differ on off-diagonal hops) — the
+    varlen × ring composition; cross-document pairs mask inside the flash
+    kernel, and a hop whose whole KV block is cross-document yields dead
+    rows (lse = -inf) that the merge ignores."""
     def skip(_):
         b, s, h, d = q.shape
         return (_as_varying(jnp.zeros_like(q), q, axis_name),
@@ -81,21 +87,27 @@ def _block(q, k, v, mode, scale, axis_name):
 
     def full(_):
         return flash_attention(q, k, v, causal=False, scale=scale,
-                               return_lse=True)
+                               return_lse=True, segment_ids=seg_q,
+                               kv_segment_ids=seg_kv)
 
     def diag(_):
         return flash_attention(q, k, v, causal=True, scale=scale,
-                               return_lse=True)
+                               return_lse=True, segment_ids=seg_q,
+                               kv_segment_ids=seg_kv)
 
     return lax.switch(mode, (skip, full, diag), None)
 
 
 def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None, segment_ids=None):
     """Per-shard ring attention (run inside shard_map over ``axis_name``).
 
     q/k/v: this rank's sequence slice, (B, S_local, H, D) / (B, S_local,
     H_kv, D).  Global sequence order = rank order along the axis.
+    ``segment_ids``: this rank's slice of the packed-document ids,
+    (B, S_local) — they rotate around the ring WITH the KV blocks, so each
+    hop masks local queries against the visiting block's documents (the
+    varlen × context-parallel composition; LSE merge is unchanged).
     Returns (out, lse) for the local slice.
     """
     if scale is None:
@@ -103,34 +115,46 @@ def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True,
     p = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % p) for i in range(p)]  # KV moves to the next rank
+    seg_q = (None if segment_ids is None
+             else jnp.asarray(segment_ids, jnp.int32))
 
     def step(carry, t):
-        out, lse, kt, vt = carry
+        out, lse, kt, vt, st = carry
         src = (my - t) % p  # whose KV block we hold at hop t
         if causal:
             mode = jnp.where(src == my, 2, jnp.where(src < my, 1, 0))
         else:
             mode = jnp.asarray(1)
-        o_t, l_t = _block(q, kt, vt, mode, scale, axis_name)
+        o_t, l_t = _block(q, kt, vt, mode, scale, axis_name,
+                          seg_q=seg_q, seg_kv=st)
         out, lse = merge_attention(out, lse, o_t, l_t)
         # rotate every hop (uniform across ranks — collectives must not sit
         # under data-dependent control flow); the p-th rotation restores KV
         kt = lax.ppermute(kt, axis_name, perm)
         vt = lax.ppermute(vt, axis_name, perm)
-        return (out, lse, kt, vt), None
+        if st is not None:
+            st = lax.ppermute(st, axis_name, perm)
+        return (out, lse, kt, vt, st), None
 
     b, s, h, d = q.shape
     out0 = _as_varying(jnp.zeros_like(q), q, axis_name)
     lse0 = _as_varying(jnp.full((b, h, s), NEG_INF, jnp.float32), q,
                        axis_name)
-    (out, lse, _, _), _ = lax.scan(step, (out0, lse0, k, v), jnp.arange(p))
+    (out, lse, _, _, _), _ = lax.scan(step, (out0, lse0, k, v, seg_q),
+                                      jnp.arange(p))
     return out, lse
 
 
 def ulysses_attention_shard(q, k, v, axis_name: str, causal: bool = True,
-                            scale: Optional[float] = None):
+                            scale: Optional[float] = None, segment_ids=None):
     """Per-shard Ulysses attention: all_to_all seq↔heads, full-seq flash,
-    all_to_all back.  Heads (q and kv) must divide the axis size."""
+    all_to_all back.  Heads (q and kv) must divide the axis size.
+
+    ``segment_ids``: this rank's (B, S_local) packed-document ids; since
+    every rank sees the FULL sequence after the all_to_all (on a head
+    slice), the ids are all-gathered along the axis — (B, S) int32 is
+    cheap on the wire — and the flash kernel masks as in the single-shard
+    varlen case."""
     p = lax.axis_size(axis_name)
 
     def to_full_seq(x):  # (B, S/p, H, D) -> (B, S, H/p, D)
@@ -146,8 +170,11 @@ def ulysses_attention_shard(q, k, v, axis_name: str, causal: bool = True,
                          f"(q heads {q.shape[2]}, kv heads {k.shape[2]}, "
                          f"degree {p})")
     qf, kf, vf = to_full_seq(q), to_full_seq(k), to_full_seq(v)
+    seg_full = (None if segment_ids is None
+                else lax.all_gather(jnp.asarray(segment_ids, jnp.int32),
+                                    axis_name, axis=1, tiled=True))
     out, lse = flash_attention(qf, kf, vf, causal=causal, scale=scale,
-                               return_lse=True)
+                               return_lse=True, segment_ids=seg_full)
     # lse is (B, H/p, S_global): transpose back to the per-shard contract
     # (B, H_local, S_local) that ring_attention_shard honours
     lse = lax.all_to_all(lse, axis_name, split_axis=2, concat_axis=1,

@@ -148,3 +148,115 @@ def test_ulysses_lse_layout_matches_contract():
                                            return_lse=True)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
                                rtol=3e-4, atol=3e-4)
+
+
+# ---------------------------------------------------------------------------
+# varlen (packed sequences) × context parallelism — round-3 verdict #2
+# ---------------------------------------------------------------------------
+
+def _segments(b, s, n_docs, seed=0):
+    """Random doc boundaries → (B, S) int32 non-decreasing segment ids."""
+    rng = np.random.default_rng(seed)
+    seg = np.zeros((b, s), np.int32)
+    for i in range(b):
+        cuts = np.sort(rng.choice(np.arange(1, s), n_docs - 1,
+                                  replace=False))
+        seg[i] = np.searchsorted(cuts, np.arange(s), side="right")
+    return jnp.asarray(seg)
+
+
+def _masked_ref(q, k, v, seg, causal=True):
+    from paddle_tpu.ops.attention import segment_mask
+    mask = segment_mask(seg, seg)
+    return flash_attention_reference(q, k, v, attn_mask=mask, causal=causal,
+                                     return_lse=True)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_varlen_matches_packed_oracle(causal):
+    """Segment ids rotate with the KV blocks; every hop masks cross-document
+    pairs — result equals the single-device packed (masked) computation."""
+    b, s, h, d = 2, 64, 4, 16
+    q, k, v = _rand((b, s, h, d), 70), _rand((b, s, h, d), 71), \
+        _rand((b, s, h, d), 72)
+    seg = _segments(b, s, n_docs=4, seed=7)
+    mesh = _sep_mesh(4)
+    fn = jax.shard_map(
+        lambda q_, k_, v_, s_: ring_attention_shard(
+            q_, k_, v_, "sep", causal=causal, segment_ids=s_),
+        mesh=mesh, in_specs=(P(None, "sep"),) * 3 + (P(None, "sep"),),
+        out_specs=(P(None, "sep"), P(None, None, "sep")))
+    out, lse = fn(q, k, v, seg)
+    ref, ref_lse = _masked_ref(q, k, v, seg, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_ring_varlen_grads_match_packed_oracle():
+    b, s, h, d = 1, 64, 2, 16
+    q, k, v = _rand((b, s, h, d), 80), _rand((b, s, h, d), 81), \
+        _rand((b, s, h, d), 82)
+    w = _rand((b, s, h, d), 83)
+    seg = _segments(b, s, n_docs=3, seed=9)
+    mesh = _sep_mesh(4)
+
+    ring = jax.shard_map(
+        lambda q_, k_, v_, s_: ring_attention_shard(
+            q_, k_, v_, "sep", causal=True, segment_ids=s_)[0],
+        mesh=mesh, in_specs=(P(None, "sep"),) * 3 + (P(None, "sep"),),
+        out_specs=P(None, "sep"))
+
+    gr = jax.grad(lambda q_, k_, v_: jnp.sum(ring(q_, k_, v_, seg) * w),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(
+        lambda q_, k_, v_: jnp.sum(_masked_ref(q_, k_, v_, seg)[0] * w),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_varlen_matches_packed_oracle(causal):
+    b, s, h, d = 2, 64, 8, 16
+    q, k, v = _rand((b, s, h, d), 90), _rand((b, s, h, d), 91), \
+        _rand((b, s, h, d), 92)
+    seg = _segments(b, s, n_docs=4, seed=11)
+    mesh = _sep_mesh(4)
+    fn = jax.shard_map(
+        lambda q_, k_, v_, s_: ulysses_attention_shard(
+            q_, k_, v_, "sep", causal=causal, segment_ids=s_),
+        mesh=mesh, in_specs=(P(None, "sep"),) * 3 + (P(None, "sep"),),
+        out_specs=(P(None, "sep"), P(None, None, "sep")))
+    out, lse = fn(q, k, v, seg)
+    ref, ref_lse = _masked_ref(q, k, v, seg, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_context_parallel_attention_varlen_in_jit():
+    """Model-facing wrapper with segment_ids on the hybrid mesh."""
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, sep_degree=2,
+                                      mp_degree=2)
+    dist.set_hybrid_group(hcg)
+    try:
+        b, s, h, d = 2, 32, 4, 16
+        q, k, v = _rand((b, s, h, d), 100), _rand((b, s, h, d), 101), \
+            _rand((b, s, h, d), 102)
+        seg = _segments(b, s, n_docs=3, seed=13)
+
+        @jax.jit
+        def f(q, k, v, seg):
+            return dist.context_parallel_attention(
+                q, k, v, causal=True, mode="ring", segment_ids=seg)
+
+        out = f(q, k, v, seg)
+        ref, _ = _masked_ref(q, k, v, seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-4, atol=3e-4)
+    finally:
+        dist.set_hybrid_group(None)

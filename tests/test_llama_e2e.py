@@ -203,7 +203,73 @@ def test_packed_sequences_match_per_document_forward():
     unmasked = causal_lm_loss(plain_logits, labels)
     assert abs(float(unmasked) - float(loss)) > 1e-6
 
-    # ring-CP + packing is an explicit NotImplementedError, not silence
+    # ring-CP + packing: without a sep mesh the CP wrapper falls back to
+    # plain segment-masked flash — must equal the gspmd packed forward
+    pt.seed(17)  # identical init to `model`
     model_cp = LlamaForCausalLM(tiny_llama_config())  # default: ring
-    with pytest.raises(NotImplementedError, match="segment_ids"):
-        model_cp(ids, segment_ids=seg)
+    model_cp.eval()
+    cp_logits = model_cp(ids, position_ids=pos, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(cp_logits),
+                               np.asarray(plain_logits),
+                               rtol=2e-4, atol=2e-4)
+
+
+def _packed_batches():
+    """Training batches where every row packs two documents: segment ids +
+    per-document positions; labels left raw (compute_loss masks boundaries)."""
+    rng = np.random.RandomState(77)
+    out = []
+    d1 = SEQ // 2 + 3  # uneven split so the sep shard boundary crosses a doc
+    for _ in range(STEPS):
+        ids = rng.randint(0, 256, (BATCH, SEQ + 1))
+        seg = np.asarray([[0] * d1 + [1] * (SEQ - d1)] * BATCH, np.int32)
+        pos = np.asarray([list(range(d1)) + list(range(SEQ - d1))] * BATCH,
+                         np.int32)
+        out.append({"input_ids": jnp.asarray(ids[:, :-1]),
+                    "labels": jnp.asarray(ids[:, 1:]),
+                    "segment_ids": jnp.asarray(seg),
+                    "position_ids": jnp.asarray(pos)})
+    return out
+
+
+def _run_packed(hcg, context_parallel="ring"):
+    pt.seed(123)
+    model = LlamaForCausalLM(
+        tiny_llama_config(context_parallel=context_parallel))
+    opt = AdamW(learning_rate=1e-3, weight_decay=0.01)
+    step, params, opt_state = dist.build_train_step(model, opt, hcg=hcg)
+    losses = []
+    key = jax.random.key(0)
+    for i, b in enumerate(_packed_batches()):
+        batch = dist.shard_batch(b, hcg)
+        loss, params, opt_state = step(params, opt_state, batch,
+                                       jax.random.fold_in(key, i))
+        losses.append(float(loss))
+    return losses
+
+
+def test_sep_axis_packed_matches_single_device(single_dev):
+    """Varlen × context parallelism (round-3 verdict #2): packed training
+    batches under a sep=2 ring must reproduce the single-device packed loss
+    curve."""
+    ref = _run_packed(single_dev)
+    dist.set_hybrid_group(None)
+    hcg = _hybrid(dp=2, mp=2, sep=2)
+    try:
+        got = _run_packed(hcg)
+    finally:
+        dist.set_hybrid_group(None)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sep_axis_packed_ulysses_matches_single_device(single_dev):
+    ref = _run_packed(single_dev, context_parallel="ulysses")
+    dist.set_hybrid_group(None)
+    # no mp: ulysses needs kv heads (2) divisible by sep, and mp=2 would
+    # leave 1 kv head per mp rank
+    hcg = _hybrid(dp=4, sep=2)
+    try:
+        got = _run_packed(hcg, context_parallel="ulysses")
+    finally:
+        dist.set_hybrid_group(None)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
