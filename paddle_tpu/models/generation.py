@@ -49,20 +49,69 @@ def cache_mask(pos, q_len: int, kv_len: int):
     return (kj <= qi)[None, None]
 
 
+def _place_on_mesh(model, params, cache, input_ids):
+    """Mesh-native decode (round-3 verdict #3): when a hybrid mesh is
+    active, lay the decode state out on it before jitting —
+
+      * params per their declared TP/FSDP specs (so lm_head stays
+        vocab-parallel on ``mp`` and the logits matmul runs sharded, with
+        GSPMD inserting the argmax/sample reduction collectives);
+      * the stacked KV cache (L, 2, B, max_len, Hkv, D): batch over
+        dp×sharding, kv heads over ``mp`` — the serving layout matching
+        how training shards attention;
+      * input ids: batch over dp×sharding.
+
+    Single-device (no mesh): unchanged pass-through.  Recurrent decode
+    states (Mamba/RWKV pytrees) are left unplaced — GSPMD propagates from
+    the params/ids, and their state layouts are model-specific.
+    """
+    from ..distributed import env as _denv
+
+    mesh = _denv.active_mesh()
+    if mesh is None or all(mesh.shape[a] == 1 for a in mesh.axis_names):
+        return params, cache, input_ids
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed.fleet.mp_layers import _filter_spec
+
+    names = set(mesh.axis_names)
+
+    def ns(*entries):
+        return NamedSharding(mesh, P(*_filter_spec(entries, names)))
+
+    specs = model.param_shardings(include_buffers=True)
+    params = {
+        k: jax.device_put(v, NamedSharding(
+            mesh, P(*_filter_spec(tuple(specs.get(k) or P()), names))))
+        for k, v in params.items()}
+    batch = tuple(a for a in ("dp", "sharding") if a in names)
+    input_ids = jax.device_put(input_ids, ns(batch))
+    if isinstance(cache, jax.Array) and cache.ndim == 6:
+        cache = jax.device_put(cache, ns(None, None, batch, None, "mp",
+                                         None))
+    return params, cache, input_ids
+
+
 def greedy_generate(model, input_ids, max_new_tokens: int,
                     eos_token_id: Optional[int] = None,
                     pad_token_id: int = 0,
                     temperature: float = 0.0,
                     top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
                     seed: int = 0,
                     max_length: Optional[int] = None,
-                    extra_inputs: Optional[dict] = None):
+                    extra_inputs: Optional[dict] = None,
+                    num_beams: int = 1,
+                    length_penalty: float = 1.0):
     """Generate ``max_new_tokens`` continuations for a batch of prompts.
 
     ``model`` must expose ``decode_step(input_ids, cache, pos) ->
     (logits, cache)`` and ``.config``.  ``temperature == 0`` is greedy
     (the parity-tested path); ``temperature > 0`` samples, optionally
-    top-k-truncated.  Returns int32 (batch, prompt_len + max_new_tokens);
+    top-k- and/or top-p- (nucleus-) truncated.  ``num_beams > 1`` switches
+    to beam search (see :func:`beam_search_generate`; the sampling knobs
+    must be off).  Returns int32 (batch, prompt_len + max_new_tokens);
     rows that hit ``eos_token_id`` are padded with ``pad_token_id``.
 
     ``extra_inputs``: dict of arrays forwarded to every ``decode_step``
@@ -72,6 +121,18 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
     """
     from ..nn.layer import bind_params
 
+    if num_beams > 1:
+        if temperature != 0.0 or top_k is not None or top_p is not None:
+            raise ValueError("beam search is deterministic: temperature/"
+                             "top_k/top_p must be unset with num_beams > 1")
+        return beam_search_generate(
+            model, input_ids, max_new_tokens, num_beams=num_beams,
+            eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+            length_penalty=length_penalty, max_length=max_length,
+            extra_inputs=extra_inputs)
+    if max_new_tokens < 1:  # lax.scan(length=max_new_tokens-…) would give
+        raise ValueError(    # an opaque negative-length error instead
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s = input_ids.shape
     total = max_length if max_length is not None else s + max_new_tokens
@@ -92,6 +153,8 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
     else:
         cache = init_kv_cache(model.config, b, total)
     params = model.state_dict(include_buffers=True)
+    params, cache, input_ids = _place_on_mesh(model, params, cache,
+                                              input_ids)
 
     def pick(logits, key):
         logits = logits.astype(jnp.float32)
@@ -101,6 +164,8 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
         if top_k is not None:
             kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            logits = _nucleus_mask(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     extra = extra_inputs or {}
@@ -108,7 +173,7 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
     # repeat generate() calls with the same shapes/settings (the serving
     # pattern) reuse the jitted program instead of re-tracing every call
     cache_key = (b, s, total, max_new_tokens, eos_token_id, pad_token_id,
-                 temperature, top_k,
+                 temperature, top_k, top_p,
                  tuple(sorted((k, v.shape) for k, v in extra.items())))
     gen_cache = getattr(model, "_generate_jit_cache", None)
     if gen_cache is None:
@@ -150,6 +215,170 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
 
     gen_cache[cache_key] = run
     out = run(params, input_ids, cache, jax.random.key(seed), extra)
+    return jnp.concatenate([input_ids, out], axis=1)
+
+
+def _nucleus_mask(logits, top_p: float):
+    """Top-p (nucleus) truncation (parity: generation_utils'
+    TopPProcess, upstream PaddleNLP layout): keep the smallest set of
+    tokens whose cumulative probability reaches ``top_p``; mask the rest
+    to -inf.  Sort-based — lax-friendly, no data-dependent shapes."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]         # desc
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # drop tokens whose PRECEDING mass already reached p (the first token
+    # is always kept); threshold = smallest kept logit
+    drop = (cum - probs) >= top_p
+    kth = jnp.min(jnp.where(drop, jnp.inf, sorted_logits), axis=-1,
+                  keepdims=True)
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _gather_state(cache, idx):
+    """Reorder decode state by flat beam indices ``idx`` (B*K,).
+
+    Batch-axis convention: the stacked KV cache (a single 6-d array,
+    (L, 2, B·K, S, H, D)) carries batch at axis 2; recurrent state pytrees
+    (Mamba's conv/ssm, RWKV's shift/wkv accumulators) carry
+    (layers, B·K, ...) — batch at axis 1."""
+    if isinstance(cache, jax.Array):
+        return jnp.take(cache, idx, axis=2)
+    return jax.tree_util.tree_map(lambda a: jnp.take(a, idx, axis=1), cache)
+
+
+def beam_search_generate(model, input_ids, max_new_tokens: int,
+                         num_beams: int = 4,
+                         eos_token_id: Optional[int] = None,
+                         pad_token_id: int = 0,
+                         length_penalty: float = 1.0,
+                         max_length: Optional[int] = None,
+                         extra_inputs: Optional[dict] = None):
+    """Beam search (parity: generation_utils' beam_search decode strategy,
+    upstream PaddleNLP layout) as one compiled ``lax.scan``.
+
+    Static beam width; every beam advances every step (finished beams emit
+    ``pad_token_id`` with probability 1, freezing their score) — no
+    data-dependent control flow, the XLA-friendly formulation.  The token
+    buffer is carried in the scan and beam-reordered each step (O(K·T) per
+    step — fine for serving-scale T; a backtracking reconstruction would
+    save bandwidth at the cost of a second scan).
+
+    Scores are summed log-probs; the returned beam maximises
+    ``score / length**length_penalty`` with ``length`` = generated tokens
+    before EOS (the GNMT length normalisation, matching the reference's
+    default beam scorer).  Returns int32 (batch, prompt + max_new_tokens).
+    """
+    from ..nn.layer import bind_params
+
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if num_beams < 2:
+        raise ValueError(f"num_beams must be >= 2, got {num_beams}")
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, s = input_ids.shape
+    k = num_beams
+    total = max_length if max_length is not None else s + max_new_tokens
+    if total < s + max_new_tokens:
+        raise ValueError(f"max_length {total} < prompt {s} + "
+                         f"max_new_tokens {max_new_tokens}")
+    limit = getattr(model.config, "max_position_embeddings", None)
+    if limit is not None and total > limit:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds the model's "
+            f"max_position_embeddings ({limit})")
+    if hasattr(model, "init_decode_state"):
+        cache = model.init_decode_state(b * k, total)
+    else:
+        cache = init_kv_cache(model.config, b * k, total)
+    params = model.state_dict(include_buffers=True)
+    params, cache, input_ids = _place_on_mesh(model, params, cache,
+                                              input_ids)
+    # decode_step sees batch B·K, so per-row side inputs (e.g. a VLM's
+    # vision features) must be beam-tiled too; beam-invariant, so no
+    # per-step reorder is needed
+    extra = {n: jnp.repeat(jnp.asarray(v), k, axis=0)
+             for n, v in (extra_inputs or {}).items()}
+
+    cache_key = ("beam", b, s, total, max_new_tokens, k, eos_token_id,
+                 pad_token_id, length_penalty,
+                 tuple(sorted((n, v.shape) for n, v in extra.items())))
+    gen_cache = getattr(model, "_generate_jit_cache", None)
+    if gen_cache is None:
+        gen_cache = model._generate_jit_cache = {}
+    if cache_key not in gen_cache:
+
+        @jax.jit
+        def run(params, input_ids, cache, extra):
+            with bind_params(model, params):
+                # prefill every beam with the same prompt (beams only
+                # diverge from step 1, when scores break the tie)
+                tiled = jnp.repeat(input_ids, k, axis=0)      # (B·K, S)
+                logits, cache = model.decode_step(tiled, cache,
+                                                  jnp.int32(0), **extra)
+                logp0 = jax.nn.log_softmax(
+                    logits[:, -1].astype(jnp.float32), axis=-1)
+                v = logp0.shape[-1]
+                # beam 0 carries the prompt; the rest start at -inf so the
+                # first expansion draws K distinct tokens from beam 0
+                init_bias = jnp.where(jnp.arange(k) == 0, 0.0, -jnp.inf)
+                scores0 = logp0.reshape(b, k, v) + init_bias[None, :, None]
+                top, flat = jax.lax.top_k(scores0.reshape(b, k * v), k)
+                tok = (flat % v).astype(jnp.int32)            # (B, K)
+                parent = flat // v
+                gidx = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+                cache = _gather_state(cache, gidx)
+                scores = top                                   # (B, K)
+                done = (jnp.zeros((b, k), bool) if eos_token_id is None
+                        else tok == eos_token_id)
+                lengths = jnp.ones((b, k), jnp.int32)
+                buf = jnp.full((b, k, max_new_tokens), pad_token_id,
+                               jnp.int32)
+                buf = buf.at[:, :, 0].set(tok)
+
+                def step(carry, i):
+                    cache, scores, buf, done, lengths, tok = carry
+                    logits, cache = model.decode_step(
+                        tok.reshape(b * k, 1), cache, jnp.int32(s) + i,
+                        **extra)
+                    logp = jax.nn.log_softmax(
+                        logits[:, -1].astype(jnp.float32), axis=-1)
+                    logp = logp.reshape(b, k, v)
+                    if eos_token_id is not None:
+                        # finished beams: pad extends with prob 1, all else
+                        # impossible — the score freezes
+                        pad_row = jnp.full((v,), -jnp.inf
+                                           ).at[pad_token_id].set(0.0)
+                        logp = jnp.where(done[:, :, None], pad_row, logp)
+                    cand = scores[:, :, None] + logp           # (B, K, V)
+                    top, flat = jax.lax.top_k(cand.reshape(b, k * v), k)
+                    tok = (flat % v).astype(jnp.int32)
+                    parent = flat // v
+                    gidx = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+                    cache = _gather_state(cache, gidx)
+                    buf = jnp.take_along_axis(buf, parent[:, :, None],
+                                              axis=1)
+                    buf = jax.lax.dynamic_update_index_in_dim(
+                        buf, tok, i + 1, axis=2)
+                    done = jnp.take_along_axis(done, parent, axis=1)
+                    lengths = jnp.take_along_axis(lengths, parent, axis=1)
+                    lengths = jnp.where(done, lengths, lengths + 1)
+                    if eos_token_id is not None:
+                        done = done | (tok == eos_token_id)
+                    return (cache, top, buf, done, lengths, tok), None
+
+                carry = (cache, scores, buf, done, lengths, tok)
+                carry, _ = jax.lax.scan(step, carry,
+                                        jnp.arange(max_new_tokens - 1))
+                _, scores, buf, done, lengths, _ = carry
+                norm = scores / (lengths.astype(jnp.float32)
+                                 ** length_penalty)
+                best = jnp.argmax(norm, axis=1)                # (B,)
+                return jnp.take_along_axis(
+                    buf, best[:, None, None], axis=1)[:, 0]    # (B, T)
+
+        gen_cache[cache_key] = run
+    out = gen_cache[cache_key](params, input_ids, cache, extra)
     return jnp.concatenate([input_ids, out], axis=1)
 
 

@@ -210,20 +210,15 @@ def test_recurrent_decode_matches_full_forward(family):
 
 def test_generate_reuses_compiled_program(lm):
     """Repeat generate() with identical shapes/settings must not re-trace."""
-    import time
-
     lm._generate_jit_cache = {}
     ids = _prompt(2, 6, seed=41)
-    t0 = time.perf_counter()
     a = lm.generate(ids, max_new_tokens=4)
-    first = time.perf_counter() - t0
     assert len(lm._generate_jit_cache) == 1
-    t0 = time.perf_counter()
     b = lm.generate(ids, max_new_tokens=4)
-    second = time.perf_counter() - t0
     assert len(lm._generate_jit_cache) == 1  # hit, no new entry
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-    assert second < first / 2, (first, second)  # no re-trace/compile
+    # (no wall-clock assertion: the cache-entry count above is the re-trace
+    # check; timing ratios flake on loaded CI machines — round-3 advisor)
 
 
 def test_ssd_scan_pads_non_divisible_lengths():
@@ -284,3 +279,209 @@ def test_qwen2_vl_greedy_generate_matches_full_forward():
     # only slightly, so assert at logits level, not token level)
     l1, l2 = model(ids, pix), model(ids, pix2)
     assert float(jnp.max(jnp.abs(l1 - l2))) > 1e-3
+
+
+# ---------------------------------------------------------------------------
+# mesh-native decode (round-3 verdict #3)
+# ---------------------------------------------------------------------------
+
+def test_mesh_decode_matches_single_device():
+    """generate() under the hybrid mesh (mp=2 × dp=2: vocab-parallel
+    logits, kv-heads sharded on mp, batch on dp) must produce exactly the
+    single-device greedy tokens."""
+    import paddle_tpu.distributed as dist
+
+    pt.seed(23)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    ids = _prompt(4, 6, seed=29)
+    want = np.asarray(model.generate(ids, max_new_tokens=8))
+
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, mp_degree=2,
+                                      devices=jax.devices()[:4])
+    dist.set_hybrid_group(hcg)
+    try:
+        model._generate_jit_cache = {}
+        got = model.generate(ids, max_new_tokens=8)
+        # the result must be mesh-sharded work, not a host fallback: check
+        # the decode state placement ran (params were device_put onto the
+        # mesh inside generate → output lives on the 4-device mesh)
+        assert len(got.devices()) == 4
+        np.testing.assert_array_equal(np.asarray(got), want)
+    finally:
+        dist.set_hybrid_group(None)
+        model._generate_jit_cache = {}
+
+
+def test_mesh_decode_with_eos_and_sampling_shapes():
+    """EOS masking and top-k sampling paths also compile on the mesh."""
+    import paddle_tpu.distributed as dist
+
+    pt.seed(31)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    ids = _prompt(4, 5, seed=37)
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, mp_degree=2,
+                                      devices=jax.devices()[:4])
+    dist.set_hybrid_group(hcg)
+    try:
+        out = model.generate(ids, max_new_tokens=4, eos_token_id=5,
+                             pad_token_id=0)
+        assert out.shape == (4, 9)
+        s = np.asarray(model.generate(ids, max_new_tokens=4,
+                                      temperature=0.7, top_k=10, seed=1))
+        assert s.shape == (4, 9)
+    finally:
+        dist.set_hybrid_group(None)
+        model._generate_jit_cache = {}
+
+
+# ---------------------------------------------------------------------------
+# beam search + top-p (round-3 verdict #5)
+# ---------------------------------------------------------------------------
+
+def _np_beam_search(full_forward, ids, n_new, k, eos=None, pad=0, lp=1.0):
+    """NumPy reference beam decoder mirroring beam_search_generate's
+    algorithm, but driven by teacher-forced FULL forwards (no cache):
+    summed log-probs, finished beams extend with pad at prob 1, GNMT
+    length normalisation."""
+    import numpy as np
+
+    def log_softmax(x):
+        x = x.astype(np.float64)
+        m = x.max(-1, keepdims=True)
+        e = np.exp(x - m)
+        return (x - m) - np.log(e.sum(-1, keepdims=True))
+
+    b, s = ids.shape
+    outs = []
+    for r in range(b):
+        prompt = list(ids[r])
+        seqs = [list() for _ in range(k)]
+        scores = np.full(k, -np.inf)
+        scores[0] = 0.0
+        done = np.zeros(k, bool)
+        lengths = np.zeros(k, np.int64)
+        for t in range(n_new):
+            cands = []
+            for bi in range(k):
+                if scores[bi] == -np.inf and t > 0:
+                    continue
+                if done[bi]:
+                    cands.append((scores[bi], bi, pad))
+                    continue
+                logits = full_forward(
+                    np.asarray([prompt + seqs[bi]], np.int32))[0, -1]
+                lp_row = log_softmax(logits)
+                for tok in range(len(lp_row)):
+                    cands.append((scores[bi] + lp_row[tok], bi, tok))
+                if t == 0:
+                    break  # only beam 0 is live at the first expansion
+            cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+            top = cands[:k]
+            seqs = [seqs[bi] + [tok] for _, bi, tok in top]
+            new_done, new_len = [], []
+            for score, bi, tok in top:
+                d = done[bi]
+                new_len.append(lengths[bi] if d else lengths[bi] + 1)
+                new_done.append(d or (eos is not None and tok == eos))
+            scores = np.asarray([c[0] for c in top])
+            done = np.asarray(new_done)
+            lengths = np.asarray(new_len)
+        norm = scores / (lengths.astype(np.float64) ** lp)
+        outs.append(prompt + seqs[int(np.argmax(norm))])
+    return np.asarray(outs, np.int32)
+
+
+@pytest.mark.parametrize("eos", [None, 5])
+def test_beam_search_matches_numpy_reference(lm, eos):
+    ids = _prompt(2, 5, seed=43)
+    n_new, k = 6, 4
+    got = np.asarray(lm.generate(ids, max_new_tokens=n_new, num_beams=k,
+                                 eos_token_id=eos, pad_token_id=0))
+    want = _np_beam_search(lambda a: np.asarray(lm(jnp.asarray(a))),
+                           np.asarray(ids), n_new, k, eos=eos, pad=0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_search_recurrent_family_matches_numpy_reference():
+    from paddle_tpu.models.rwkv import RwkvForCausalLM, tiny_rwkv_config
+
+    pt.seed(47)
+    model = RwkvForCausalLM(tiny_rwkv_config())
+    model.eval()
+    ids = _prompt(2, 4, seed=53)
+    n_new, k = 5, 4
+    got = np.asarray(model.generate(ids, max_new_tokens=n_new, num_beams=k))
+    want = _np_beam_search(lambda a: np.asarray(model(jnp.asarray(a))),
+                           np.asarray(ids), n_new, k)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_search_length_penalty_changes_choice():
+    """length_penalty is live: beam search must run with a non-default
+    value and still return well-formed output."""
+    pt.seed(49)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    ids = _prompt(2, 4, seed=59)
+    out = np.asarray(model.generate(ids, max_new_tokens=5, num_beams=3,
+                                    eos_token_id=5, length_penalty=2.0))
+    want = _np_beam_search(lambda a: np.asarray(model(jnp.asarray(a))),
+                           np.asarray(ids), 5, 3, eos=5, lp=2.0)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_beam_search_rejects_sampling_knobs(lm):
+    with pytest.raises(ValueError, match="deterministic"):
+        lm.generate(_prompt(1, 4), max_new_tokens=4, num_beams=4,
+                    temperature=0.8)
+
+
+def test_top_p_sampling_stays_in_nucleus(lm):
+    """Every sampled token must be inside the top-p nucleus of the full
+    forward's distribution at its position."""
+    ids = _prompt(1, 4, seed=61)
+    p = 0.8
+    out = np.asarray(lm.generate(ids, max_new_tokens=6, temperature=1.0,
+                                 top_p=p, seed=3))
+    for t in range(6):
+        prefix = jnp.asarray(out[:, :4 + t], jnp.int32)
+        logits = np.asarray(lm(prefix))[0, -1].astype(np.float64)
+        e = np.exp(logits - logits.max())
+        probs = e / e.sum()
+        order = np.argsort(-logits)
+        cum = np.cumsum(probs[order])
+        keep = order[np.concatenate([[True], cum[:-1] < p])]
+        assert out[0, 4 + t] in keep, (
+            f"token {out[0, 4 + t]} at step {t} outside the {p}-nucleus")
+
+
+def test_top_p_tiny_p_is_greedy(lm):
+    """p → 0 keeps only the argmax token: sampling must equal greedy."""
+    ids = _prompt(2, 4, seed=67)
+    greedy = np.asarray(lm.generate(ids, max_new_tokens=5))
+    nucl = np.asarray(lm.generate(ids, max_new_tokens=5, temperature=1.0,
+                                  top_p=1e-9, seed=11))
+    np.testing.assert_array_equal(greedy, nucl)
+
+
+def test_qwen2_vl_beam_search_tiles_extra_inputs():
+    """Beam search must beam-tile extra_inputs (vision features) to B·K —
+    the review-found crash: decode_step received B·K hidden states but B
+    vision rows."""
+    from paddle_tpu.models.qwen2_vl import (Qwen2VLForConditionalGeneration,
+                                            tiny_qwen2_vl_config)
+
+    pt.seed(51)
+    cfg = tiny_qwen2_vl_config()
+    model = Qwen2VLForConditionalGeneration(cfg)
+    model.eval()
+    rng = np.random.RandomState(53)
+    ids = _prompt(2, 4, vocab=cfg.vocab_size, seed=55)
+    pix = jnp.asarray(rng.standard_normal(
+        (2, cfg.in_channels, cfg.image_size, cfg.image_size)), jnp.float32)
+    out = np.asarray(model.generate(ids, pix, max_new_tokens=3,
+                                    num_beams=2))
+    assert out.shape == (2, 7)
+    assert np.isfinite(out).all()
