@@ -79,10 +79,53 @@ def measure(layers, vocab, batch, seq, steps, warmup, on_tpu):
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01)
     step, params, opt_state = dist.build_train_step(model, opt, hcg=hcg,
                                                     zero_stage=3)
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
-    b = dist.shard_batch({"input_ids": jnp.asarray(ids[:, :-1]),
-                          "labels": jnp.asarray(ids[:, 1:])}, hcg)
+
+    # input pipeline through the native C++ loader (io/native.py): a token
+    # bin on disk, mmap windows, threaded batch assembly, fetched *inside*
+    # the timed loop — host input time is part of the MFU number (or
+    # provably overlapped), per the round-3 verdict.  Falls back to a fixed
+    # in-memory batch only when no g++ toolchain exists.
+    import tempfile
+
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.native import MMapTokenDataset, available as native_ok
+
+    cleanup = []
+    if native_ok():
+        rng = np.random.RandomState(0)
+        n_samples = 64 * batch
+        toks = rng.randint(0, min(cfg.vocab_size, 65535),
+                           n_samples * (seq + 1)).astype(np.uint16)
+        f = tempfile.NamedTemporaryFile(suffix=".bin", delete=False)
+        toks.tofile(f)
+        f.close()
+        ds = MMapTokenDataset(f.name, seq_len=seq + 1, stride=seq + 1)
+        # prefetch_factor=1 → no Python prefetch thread (the C++ worker
+        # pool already runs ahead); keeps generator shutdown deterministic
+        dl = DataLoader(ds, batch_size=batch, shuffle=True, num_workers=2,
+                        prefetch_factor=1)
+
+        def _stream():
+            while True:  # cycle epochs; the loader reshuffles each pass
+                yield from dl
+
+        _it = _stream()
+        cleanup = [_it, ds, f.name]
+
+        def next_batch():
+            ids = next(_it)
+            return dist.shard_batch({"input_ids": jnp.asarray(ids[:, :-1]),
+                                     "labels": jnp.asarray(ids[:, 1:])}, hcg)
+    else:
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+        fixed = dist.shard_batch({"input_ids": jnp.asarray(ids[:, :-1]),
+                                  "labels": jnp.asarray(ids[:, 1:])}, hcg)
+
+        def next_batch():
+            return fixed
+
+    b = next_batch()
     key = jax.random.key(0)
     # HBM accounting: runtime peak_bytes_in_use when the backend exposes it;
     # the axon tunnel does not (memory_stats() → None), so fall back to
@@ -99,17 +142,25 @@ def measure(layers, vocab, batch, seq, steps, warmup, on_tpu):
         step = compiled  # AOT executable: don't pay a second jit compile
     except Exception:
         pass
-    loss = None
-    for i in range(warmup):
-        loss, params, opt_state = step(params, opt_state, b,
-                                       jax.random.fold_in(key, i))
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss, params, opt_state = step(params, opt_state, b,
-                                       jax.random.fold_in(key, warmup + i))
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    try:
+        loss = None
+        for i in range(warmup):
+            loss, params, opt_state = step(params, opt_state, next_batch(),
+                                           jax.random.fold_in(key, i))
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss, params, opt_state = step(
+                params, opt_state, next_batch(),
+                jax.random.fold_in(key, warmup + i))
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    finally:  # an OOM mid-loop must not leak the bin file / C++ workers
+        for c in cleanup:
+            if isinstance(c, str):
+                os.unlink(c)
+            else:
+                c.close()
     ms = jax.local_devices()[0].memory_stats() or {}
     if ms.get("peak_bytes_in_use"):
         hbm = {"peak": int(ms["peak_bytes_in_use"]),
@@ -142,15 +193,16 @@ def run_single(args):
 
 
 def spawn_point(layers, vocab, batch, seq, steps, warmup, peak_flops,
-                timeout=480):
+                timeout=480, extra_env=None):
     cmd = [sys.executable, os.path.abspath(__file__), "--single",
            "--layers", str(layers), "--vocab", str(vocab),
            "--batch", str(batch), "--seq", str(seq),
            "--steps", str(steps), "--warmup", str(warmup),
            "--peak-flops", str(peak_flops)]
+    env = dict(os.environ, **(extra_env or {}))
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout)
+                           timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
         return None
     for line in r.stdout.splitlines():
@@ -159,9 +211,144 @@ def spawn_point(layers, vocab, batch, seq, steps, warmup, peak_flops,
     return None
 
 
+# ---------------------------------------------------------------------------
+# --op mode: the checked-in op-level perf harness (round-3 verdict #7).
+# Reproduces the measurement tables that ops/norms.py and flags.py cite,
+# so kernel perf claims and dispatch thresholds are re-derivable from the
+# repo instead of resting on docstring numbers.  Results accumulate into
+# BENCH_OPS.json (one section per op, device-tagged).
+# ---------------------------------------------------------------------------
+
+def _time_compiled(fn, args, steps):
+    """Mean wall time of a jitted fn: AOT-compile, warm once, block only on
+    the output (BASELINE.md measurement plan), plus XLA memory analysis."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*args).compile()
+    ma = compiled.memory_analysis()
+    mem = {"args": int(ma.argument_size_in_bytes),
+           "temp": int(ma.temp_size_in_bytes),
+           "output": int(ma.output_size_in_bytes)}
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = compiled(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps, mem
+
+
+def run_op_rms_norm(steps):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.norms import rms_norm_reference
+    from paddle_tpu.ops.pallas.rms_norm import rms_norm_pallas
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    interpret = not on_tpu
+    shapes = [(512, 65536), (4096, 32768), (2048, 16384), (8192, 8192),
+              (8192, 4096)]
+    dtypes = ["bfloat16", "float32"] if on_tpu else ["float32"]
+    rows = []
+    for rows_n, dim in shapes:
+        for dname in dtypes:
+            dt = getattr(jnp, dname)
+            key = jax.random.key(0)
+            x = jax.random.normal(key, (rows_n, dim), dt)
+            w = jnp.ones((dim,), dt)
+            t_ref, m_ref = _time_compiled(
+                lambda a, b: rms_norm_reference(a, b), (x, w), steps)
+            t_pal, m_pal = _time_compiled(
+                lambda a, b: rms_norm_pallas(a, b, 1e-6,
+                                             interpret=interpret),
+                (x, w), steps)
+            rows.append({"shape": [rows_n, dim], "dtype": dname,
+                         "xla_ms": round(t_ref * 1e3, 4),
+                         "pallas_ms": round(t_pal * 1e3, 4),
+                         "speedup": round(t_ref / t_pal, 3),
+                         "mem_xla": m_ref, "mem_pallas": m_pal})
+    # re-derive the dispatch threshold: smallest row length whose bf16
+    # (fp32 on CPU) speedup clears 1.1x on every measured point at or
+    # above it — the flag default should equal this
+    pref = dtypes[0]
+    by_dim = {}
+    for r in rows:
+        if r["dtype"] == pref:
+            by_dim.setdefault(r["shape"][1], []).append(r["speedup"])
+    dims = sorted(by_dim)
+    threshold = None
+    for i, d in enumerate(dims):
+        if all(min(by_dim[dd]) >= 1.1 for dd in dims[i:]):
+            threshold = d
+            break
+    return {"steps": steps, "rows": rows,
+            "derived_min_dim_threshold": threshold,
+            "threshold_rule": "smallest dim with >=1.1x pallas speedup at "
+                              f"every measured dim above it ({pref})"}
+
+
+def run_op_flash(steps, warmup):
+    """Flash-attention block sweep at full-train-step MFU — the right
+    methodology for a tunnel-attached chip where op-microbench timings are
+    dominated by dispatch latency (flags.py block-default provenance)."""
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu:
+        return {"skipped": "flash block sweep needs the real chip"}
+    peak_flops = 197e12 if ("v5 lite" in dev.device_kind
+                            or "v5e" in dev.device_kind) else 459e12
+    blocks = [(256, 512), (512, 512), (512, 1024), (1024, 1024),
+              (1024, 2048)]
+    rows = []
+    for bq, bkv in blocks:
+        p = spawn_point(4, 8192, 2, 2048, steps, warmup, peak_flops,
+                        extra_env={"FLAGS_flash_attention_block_q": str(bq),
+                                   "FLAGS_flash_attention_block_kv":
+                                       str(bkv)})
+        rows.append({"block_q": bq, "block_kv": bkv,
+                     "mfu_6nd": None if p is None else p["mfu_6nd"],
+                     "step_time_s": None if p is None else p["step_time_s"],
+                     "note": "OOM/failed" if p is None else ""})
+    ok = [r for r in rows if r["mfu_6nd"] is not None]
+    best = max(ok, key=lambda r: r["mfu_6nd"]) if ok else None
+    return {"workload": "llama3-arch 4L bs2 seq2048 vocab8192, zero3 + "
+                        "dots remat, full train step", "steps": steps,
+            "rows": rows, "best": best}
+
+
+def run_op_bench(args):
+    import jax
+
+    dev = jax.devices()[0]
+    section = (run_op_rms_norm(args.steps) if args.op == "rms_norm"
+               else run_op_flash(args.steps, args.warmup))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_OPS.json")
+    blob = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            blob = json.load(f)
+    section["device"] = dev.device_kind
+    section["platform"] = dev.platform
+    section["when"] = time.strftime("%Y-%m-%d")
+    blob[args.op] = section
+    with open(path, "w") as f:
+        json.dump(blob, f, indent=1)
+    print(json.dumps({"metric": f"op_bench_{args.op}",
+                      "value": 1, "unit": "artifact",
+                      "vs_baseline": 0.0,
+                      "detail": {"artifact": "BENCH_OPS.json",
+                                 "section": section}}))
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="iterations (default: 20 for the train bench, "
+                         "50 for --op rms_norm)")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
@@ -173,7 +360,16 @@ def main():
     ap.add_argument("--selftest", action="store_true",
                     help="run the real-TPU test lane (pytest -m tpu on this "
                          "chip) instead of the benchmark")
+    ap.add_argument("--op", choices=["rms_norm", "flash"],
+                    help="op-level perf harness: reproduce the kernel "
+                         "measurement tables into BENCH_OPS.json")
     args = ap.parse_args()
+    if args.steps is None:
+        args.steps = 50 if args.op == "rms_norm" else 20
+
+    if args.op:
+        run_op_bench(args)
+        return
 
     if args.selftest:
         # The reference's GPU-CI-lane equivalent: Pallas kernels via Mosaic,

@@ -52,6 +52,13 @@ class LaunchConfig:
     log_dir: Optional[str] = None     # per-worker logs; None = inherit stdio
     devices_per_proc: Optional[int] = None  # cpu backend: fake device count
     monitor_interval: float = 0.5
+    # Topology-elastic restart (SURVEY §7 hard part (d), the reference's
+    # ElasticManager scale-in/out): restart_nprocs[k-1] is the world size
+    # for restart incarnation k — e.g. nprocs=2, restart_nprocs=[1] models
+    # losing a host and resuming on the survivor.  Training scripts need no
+    # special handling beyond checkpoint/resume: load_state_dict reshards
+    # to whatever mesh the new incarnation builds.
+    restart_nprocs: Optional[Sequence[int]] = None
 
 
 class _Worker:
@@ -62,18 +69,19 @@ class _Worker:
 
 
 def _spawn(cmd: Sequence[str], cfg: LaunchConfig, coordinator: str,
-           restart_num: int) -> List[_Worker]:
+           restart_num: int, nprocs: Optional[int] = None) -> List[_Worker]:
+    nprocs = nprocs if nprocs is not None else cfg.nprocs
     workers = []
-    for rank in range(cfg.nprocs):
+    for rank in range(nprocs):
         env = dict(os.environ)
         env.update({
             "COORDINATOR_ADDRESS": coordinator,
-            "NUM_PROCESSES": str(cfg.nprocs),
+            "NUM_PROCESSES": str(nprocs),
             "PROCESS_ID": str(rank),
             "PADDLE_TPU_RESTART_NUM": str(restart_num),
             # reference-parity aliases
             "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(cfg.nprocs),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
         })
         if cfg.backend == "cpu":
             env["PADDLE_TPU_BACKEND"] = "cpu"
@@ -122,8 +130,14 @@ def elastic_run(cmd: Sequence[str], cfg: LaunchConfig) -> int:
     Returns the final exit code (0 = a full group completed)."""
     restart_num = 0
     while True:
+        nprocs = cfg.nprocs
+        if restart_num > 0 and cfg.restart_nprocs:
+            # elastic topology change: incarnation k runs at the declared
+            # world size (clamped to the last entry once the list runs out)
+            idx = min(restart_num - 1, len(cfg.restart_nprocs) - 1)
+            nprocs = cfg.restart_nprocs[idx]
         coordinator = cfg.master or f"127.0.0.1:{find_free_port()}"
-        workers = _spawn(cmd, cfg, coordinator, restart_num)
+        workers = _spawn(cmd, cfg, coordinator, restart_num, nprocs)
         failed: Optional[int] = None
         try:
             while True:

@@ -112,6 +112,8 @@ DEFINE("flash_attention_force", False,
 #  (bq,bkv): (256,512)=0.579  (512,512)=0.598  (512,1024)=0.611
 #            (1024,1024)=0.624  (1024,2048)=VMEM OOM
 # larger q tiles amortise the kv streaming; 1024x1024 is the VMEM ceiling
+# reproducible: `python bench.py --op flash` re-runs the sweep and records
+# it in BENCH_OPS.json (round-3 verdict #7)
 DEFINE("flash_attention_block_q", 1024,
        "Pallas flash-attention q block size")
 DEFINE("rms_norm_pallas_min_dim", 32768,

@@ -18,7 +18,12 @@ IS.  This module keeps that ground truth as data:
     to absent, keeping coverage claims honest.
 
 Names listed here but not implemented are *deliberately* visible: the
-absent list is the work queue, not an embarrassment to hide.
+absent list is the work queue, not an embarrassment to hide.  As of round
+4 the target reaches past what is implemented (fft/signal/vision/sparse
+namespaces, the paddle.Tensor method surface, detection/CTC ops), so the
+absent list is non-empty by construction — CI prints it every run
+(tests/test_op_registry.py) and pins both a floor on implemented counts
+and a *ceiling* on absences so the queue only shrinks.
 """
 
 from __future__ import annotations
@@ -37,6 +42,7 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "empty_like", "eye", "full", "full_like", "linspace", "logspace",
         "meshgrid", "ones", "ones_like", "to_tensor", "tril", "triu",
         "zeros", "zeros_like",
+        "complex", "polar", "tril_indices", "triu_indices",
     ],
     "paddle.manipulation": [
         "as_strided", "broadcast_to", "cast", "chunk", "concat", "expand",
@@ -46,6 +52,10 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "scatter_nd_add", "slice", "split", "squeeze", "stack",
         "strided_slice", "take_along_axis", "tile", "transpose", "unbind",
         "unique", "unsqueeze", "unstack", "view",
+        "as_complex", "as_real", "atleast_1d", "atleast_2d", "atleast_3d",
+        "block_diag", "column_stack", "crop", "dsplit", "dstack", "hsplit",
+        "hstack", "masked_scatter", "row_stack", "tensor_split",
+        "unflatten", "unique_consecutive", "vsplit", "vstack",
     ],
     "paddle.math": [
         "abs", "acos", "acosh", "add", "add_n", "all", "amax", "amin",
@@ -62,6 +72,12 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "remainder", "round", "rsqrt", "sigmoid", "sign", "sin", "sinh",
         "sqrt", "square", "stanh", "subtract", "sum", "tan", "tanh",
         "trace", "trapezoid", "trunc", "vander",
+        "addmm", "bincount", "cdist", "combinations", "copysign",
+        "cumulative_trapezoid", "diag_embed", "diagonal", "frexp",
+        "gammainc", "gammaincc", "gammaln", "gcd", "hypot", "i0", "i0e",
+        "i1", "i1e", "index_add", "index_fill", "index_put", "kron",
+        "lcm", "ldexp", "logaddexp", "multigammaln", "nextafter",
+        "polygamma", "renorm", "sgn", "sinc", "take", "tensordot",
     ],
     "paddle.logic": [
         "allclose", "bitwise_and", "bitwise_not", "bitwise_or",
@@ -69,6 +85,9 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "greater_than", "is_empty", "isclose", "isfinite", "isinf",
         "isnan", "less_equal", "less_than", "logical_and", "logical_not",
         "logical_or", "logical_xor", "not_equal", "where",
+        "bitwise_left_shift", "bitwise_right_shift", "is_complex",
+        "is_floating_point", "is_integer", "isneginf", "isposinf",
+        "isreal",
     ],
     "paddle.search": [
         "argmax", "argmin", "argsort", "bucketize", "histogram",
@@ -79,6 +98,7 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "bernoulli", "exponential", "multinomial", "normal", "poisson",
         "rand", "randint", "randn", "randperm", "shuffle",
         "standard_normal", "uniform",
+        "binomial", "log_normal", "standard_gamma",
     ],
     "paddle.linalg": [
         "cholesky", "cholesky_solve", "cond", "det", "dist", "eig",
@@ -95,6 +115,27 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "rms_norm", "scaled_dot_product_attention", "sigmoid", "silu",
         "smooth_l1_loss", "softmax", "softmax_with_cross_entropy",
         "softplus", "swiglu", "swish", "tanh", "unfold",
+        # round-4 breadth
+        "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+        "adaptive_max_pool1d", "adaptive_max_pool2d", "affine_grid",
+        "alpha_dropout", "avg_pool1d", "avg_pool3d", "batch_norm",
+        "binary_cross_entropy", "binary_cross_entropy_with_logits", "celu",
+        "channel_shuffle", "conv1d", "conv1d_transpose", "conv2d_transpose",
+        "conv3d", "conv3d_transpose", "cosine_embedding_loss",
+        "cosine_similarity", "dice_loss", "dropout2d", "dropout3d", "elu",
+        "fold", "glu", "grid_sample", "gumbel_softmax", "hardshrink",
+        "hardsigmoid", "hardtanh", "hinge_embedding_loss", "instance_norm",
+        "kl_div", "l1_loss", "label_smooth", "local_response_norm",
+        "log_loss", "log_sigmoid", "margin_ranking_loss", "max_pool1d",
+        "max_pool3d", "maxout", "multi_label_soft_margin_loss", "nll_loss",
+        "normalize", "pixel_shuffle", "pixel_unshuffle", "poisson_nll_loss",
+        "rrelu", "selu", "sequence_mask", "sigmoid_focal_loss",
+        "soft_margin_loss", "softshrink", "softsign", "square_error_cost",
+        "tanhshrink", "thresholded_relu", "triplet_margin_loss", "upsample",
+        "zeropad2d",
+        # work queue (absent): dynamic-alignment / specialised losses
+        "ctc_loss", "margin_cross_entropy", "class_center_sample",
+        "temporal_shift",
     ],
     "paddle.incubate": [
         # fused / long-context ops (upstream: paddle.incubate.nn.functional
@@ -115,6 +156,48 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "ConstantLR", "CosineAnnealingDecay", "ExponentialDecay",
         "LRScheduler", "LinearWarmup", "MultiStepDecay", "NoamDecay",
         "PolynomialDecay", "StepDecay",
+    ],
+    # -- round-4 breadth namespaces ----------------------------------------
+    "paddle.fft": [
+        "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+        "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
+        "rfftfreq", "fftshift", "ifftshift",
+    ],
+    "paddle.signal": ["stft", "istft"],
+    "paddle.vision.ops": [
+        "box_coder", "nms", "prior_box", "roi_align", "roi_pool",
+        "yolo_box",
+        # work queue (absent): remaining detection kernels
+        "deform_conv2d", "distribute_fpn_proposals", "generate_proposals",
+        "matrix_nms", "psroi_pool", "yolo_loss",
+    ],
+    "paddle.sparse": [
+        "sparse_coo_tensor", "sparse_csr_tensor", "coalesce",
+        "is_same_shape", "matmul", "addmm", "mv", "transpose", "reshape",
+        "add", "subtract", "multiply", "divide", "sin", "tan", "asin",
+        "atan", "sinh", "tanh", "asinh", "atanh", "sqrt", "square",
+        "log1p", "abs", "expm1", "pow", "cast", "neg", "rad2deg",
+        "deg2rad",
+        # work queue (absent): pattern-captured kernels (cuSPARSE SDDMM /
+        # submanifold conv equivalents — Pallas targets)
+        "masked_matmul", "mask_as", "slice", "sum",
+    ],
+    "paddle.sparse.nn": [
+        "relu", "relu6", "leaky_relu",
+        # work queue (absent)
+        "softmax", "attention", "conv3d", "subm_conv3d",
+    ],
+    "paddle.Tensor": [
+        # method surface of the Tensor facade (tensor_facade.py): resolved
+        # by attribute lookup on a live instance, so jax.Array fallthrough
+        # methods count as implemented only if they actually resolve.
+        "astype", "clone", "cpu", "detach", "dim", "element_size", "item",
+        "ndimension", "numel", "numpy", "to", "tolist",
+        # dispatch-by-name methods (one per tensor-module function) are
+        # covered by the function categories; these are the extra
+        # method-only names still absent:
+        "backward", "register_hook", "to_dense", "to_sparse_coo",
+        "value_counts", "pin_memory",
     ],
 }
 
@@ -145,6 +228,12 @@ _IMPL_MODULES: Dict[str, List[str]] = {
     "paddle.distributed": ["paddle_tpu.distributed.collective"],
     "paddle.optimizer": ["paddle_tpu.optimizer"],
     "paddle.optimizer.lr": ["paddle_tpu.optimizer.lr"],
+    "paddle.fft": ["paddle_tpu.tensor.fft"],
+    "paddle.signal": ["paddle_tpu.signal"],
+    "paddle.vision.ops": ["paddle_tpu.vision.ops"],
+    "paddle.sparse": ["paddle_tpu.sparse"],
+    "paddle.sparse.nn": ["paddle_tpu.sparse.nn"],
+    "paddle.Tensor": [],  # resolved against a facade instance, see resolve()
 }
 
 
@@ -154,6 +243,9 @@ def resolve() -> Dict[str, Dict[str, Optional[Callable]]]:
 
     out: Dict[str, Dict[str, Optional[Callable]]] = {}
     for cat, names in TARGET_SURFACE.items():
+        if cat == "paddle.Tensor":
+            out[cat] = _resolve_tensor_methods(names)
+            continue
         mods = [importlib.import_module(m) for m in _IMPL_MODULES[cat]]
         table: Dict[str, Optional[Callable]] = {}
         for name in names:
@@ -172,6 +264,27 @@ def resolve() -> Dict[str, Dict[str, Optional[Callable]]]:
             table[name] = fn
         out[cat] = table
     return out
+
+
+def _resolve_tensor_methods(names) -> Dict[str, Optional[Callable]]:
+    """Resolve paddle.Tensor method names against a live facade instance —
+    the facade's __getattr__ dispatches to the tensor modules and falls
+    through to jax.Array, so a name counts as implemented exactly when a
+    user calling ``Tensor(x).name(...)`` would reach real code."""
+    import jax.numpy as jnp
+
+    from ..tensor.tensor_facade import Tensor
+
+    probe = Tensor(jnp.zeros((1,)))
+    table: Dict[str, Optional[Callable]] = {}
+    for name in names:
+        try:
+            attr = getattr(probe, name)
+        except AttributeError:
+            table[name] = None
+            continue
+        table[name] = attr if callable(attr) else (lambda a=attr: a)
+    return table
 
 
 def coverage() -> Dict[str, Tuple[int, int, List[str]]]:

@@ -204,12 +204,50 @@ class DataLoader:
                  shuffle: bool = False, sampler=None, batch_sampler=None,
                  num_workers: int = 0, collate_fn: Optional[Callable] = None,
                  drop_last: bool = False, prefetch_factor: int = 2,
-                 sharding=None):
+                 sharding=None, seed: int = 0):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(1, prefetch_factor)
         self.sharding = sharding
+        self._epoch = 0
+        # Fast path: an MMapTokenDataset routes through the native C++
+        # loader core (io/native.py — mmap + threaded batch assembly), so
+        # "DataLoader over a token bin" is the fast configuration by
+        # default, not a separate API.  Rank/world come from a
+        # DistributedBatchSampler when one is passed; collate is bypassed
+        # (the C++ workers emit the final (batch, seq) array).
+        self._native_cfg = None
+        from .native import MMapTokenDataset, available
+        if isinstance(dataset, MMapTokenDataset):
+            if not available():
+                raise RuntimeError(
+                    "MMapTokenDataset needs the native io core (no g++?); "
+                    "use a map-style Dataset for the pure-Python path")
+            rank, world = 0, 1
+            if batch_sampler is not None:
+                if not isinstance(batch_sampler, DistributedBatchSampler):
+                    raise ValueError(
+                        "MMapTokenDataset supports batch_sampler only as "
+                        "DistributedBatchSampler (rank/world source)")
+                rank = batch_sampler.rank
+                world = batch_sampler.num_replicas
+                shuffle = batch_sampler.shuffle
+                batch_size = batch_sampler.batch_size
+            self._native_cfg = {
+                "batch_size": batch_size or 1, "seed": seed,
+                "rank": rank, "world_size": world,
+                "num_workers": max(1, num_workers),
+                # C++-side prefetch queue depth; independent of the
+                # Python-side prefetch thread (which the native path
+                # doesn't need — the C++ pool already runs ahead)
+                "prefetch": max(2, self.prefetch_factor), "shuffle": shuffle}
+            self.batch_sampler = None
+            self.batch_size = batch_size or 1
+            self.drop_last = True  # native loader emits whole batches only
+            self._iterable = False
+            self._pool = None
+            return
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -218,6 +256,11 @@ class DataLoader:
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
+            if sampler is None and shuffle:
+                # honor seed= on the pure-Python path too (the native fast
+                # path already does) — same argument, same determinism
+                sampler = RandomSampler(
+                    dataset, generator=np.random.default_rng(seed))
             self.batch_sampler = BatchSampler(
                 dataset, sampler=sampler, shuffle=shuffle,
                 batch_size=batch_size or 1, drop_last=drop_last)
@@ -225,11 +268,29 @@ class DataLoader:
                       if num_workers > 0 else None)
 
     def __len__(self):
+        if self._native_cfg is not None:
+            n = len(self.dataset) // self._native_cfg["world_size"]
+            return n // self._native_cfg["batch_size"]
         if self._iterable:
             raise TypeError("IterableDataset has no length")
         return len(self.batch_sampler)
 
+    def set_epoch(self, epoch: int):
+        """Shuffle-epoch control (parity: DistributedBatchSampler.set_epoch;
+        the native fast path seeds its deterministic shuffle with it)."""
+        self._epoch = epoch
+
     def _host_batches(self) -> Iterator[Any]:
+        if self._native_cfg is not None:
+            from .native import NativeTokenLoader
+            loader = NativeTokenLoader(self.dataset, epoch=self._epoch,
+                                       **self._native_cfg)
+            try:
+                yield from loader
+            finally:
+                loader.close()
+            self._epoch += 1  # next epoch reshuffles, as the reference does
+            return
         if self._iterable:
             buf = []
             for sample in self.dataset:

@@ -12,8 +12,13 @@ oracle in tests/test_native_io.py).
 The C++ core (native/ptio.cc) is compiled on first use with the system
 g++ into a per-source-hash .so (no pip/pybind11 dependency — plain ctypes
 over an extern-C surface).  If no toolchain is available the import still
-succeeds and ``available()`` returns False; io.dataloader keeps its pure-
-Python path as the fallback.
+succeeds and ``available()`` returns False.
+
+Integration (round 4): ``io.DataLoader(dataset=MMapTokenDataset(...))``
+routes through :class:`NativeTokenLoader` automatically — token-bin
+pretraining input is the fast path of the standard API, and ``bench.py``
+feeds its train steps through it so host input time is part of the MFU
+number.  Map-style Datasets keep the pure-Python worker-pool path.
 """
 
 from __future__ import annotations

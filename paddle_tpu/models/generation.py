@@ -16,9 +16,10 @@ Design — everything is shaped for XLA's static-shape compilation model:
     done-mask) — one compiled program for the whole generation, the
     while-loop-free form XLA pipelines best;
   * attention over the cache masks key slots ``> position`` explicitly
-    (the tail of the cache is uninitialised).  Decode attention is
-    DMA-bound (q_len ∈ {1, prompt}), so it runs the XLA math path — the
-    Pallas flash kernel is a throughput kernel for training shapes;
+    (the tail of the cache is uninitialised).  Incremental decode
+    (q_len 1) is DMA-bound and runs the XLA math path; *prefill* passes a
+    static ``pos=0`` so eligible prompt shapes route through the Pallas
+    flash kernel (see llama.py ``LlamaAttention.decode``);
   * EOS handling is maskwise (``done`` flag per row, finished rows emit
     ``pad_token_id``) — no data-dependent control flow.
 """
@@ -186,9 +187,10 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
     @jax.jit
     def run(params, input_ids, cache, key, extra):
         with bind_params(model, params):
-            # prefill: one pass over the whole prompt
-            logits, cache = model.decode_step(input_ids, cache,
-                                              jnp.int32(0), **extra)
+            # prefill: one pass over the whole prompt.  pos is the STATIC
+            # int 0 (not a traced scalar) so attention layers can route
+            # prefill through the Pallas flash kernel (llama.py decode)
+            logits, cache = model.decode_step(input_ids, cache, 0, **extra)
             key, sub = jax.random.split(key)
             nxt = pick(logits[:, -1], sub)
             done = jnp.zeros((b,), bool)
@@ -314,8 +316,8 @@ def beam_search_generate(model, input_ids, max_new_tokens: int,
                 # prefill every beam with the same prompt (beams only
                 # diverge from step 1, when scores break the tie)
                 tiled = jnp.repeat(input_ids, k, axis=0)      # (B·K, S)
-                logits, cache = model.decode_step(tiled, cache,
-                                                  jnp.int32(0), **extra)
+                # static pos=0: prefill may take the flash kernel path
+                logits, cache = model.decode_step(tiled, cache, 0, **extra)
                 logp0 = jax.nn.log_softmax(
                     logits[:, -1].astype(jnp.float32), axis=-1)
                 v = logp0.shape[-1]

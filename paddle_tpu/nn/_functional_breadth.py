@@ -1,0 +1,766 @@
+"""Round-4 breadth of the ``paddle.nn.functional`` surface.
+
+Star-imported by :mod:`paddle_tpu.nn.functional`; split out only to keep
+file sizes reviewable. Same design rules as functional.py: paddle calling
+conventions (NCHW defaults, ``reduction=`` semantics), fp32 accumulation
+for normalisation/losses under bf16, XLA-friendly formulations (gathers
+instead of loops, ``lax.reduce_window`` for pooling). Upstream parity:
+python/paddle/nn/functional/{activation,loss,norm,conv,pooling,vision}.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import random as _random
+
+__all__ = [
+    # activations
+    "celu", "elu", "glu", "gumbel_softmax", "hardshrink", "hardsigmoid",
+    "hardtanh", "log_sigmoid", "maxout", "rrelu", "selu", "softshrink",
+    "softsign", "tanhshrink", "thresholded_relu",
+    # losses
+    "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "cosine_embedding_loss", "cosine_similarity", "dice_loss",
+    "hinge_embedding_loss", "kl_div", "l1_loss", "log_loss",
+    "margin_ranking_loss", "multi_label_soft_margin_loss", "nll_loss",
+    "poisson_nll_loss", "sigmoid_focal_loss", "soft_margin_loss",
+    "square_error_cost", "triplet_margin_loss",
+    # norm
+    "batch_norm", "instance_norm", "local_response_norm", "normalize",
+    # conv / pooling
+    "conv1d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "avg_pool1d", "avg_pool3d", "max_pool1d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    # vision / misc
+    "affine_grid", "grid_sample", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "fold", "upsample", "zeropad2d", "alpha_dropout",
+    "dropout2d", "dropout3d", "label_smooth", "sequence_mask",
+]
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def celu(x, alpha: float = 1.0):
+    return jnp.maximum(x, 0.0) + jnp.minimum(
+        0.0, alpha * (jnp.exp(x / alpha) - 1.0))
+
+
+def elu(x, alpha: float = 1.0):
+    return jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+def glu(x, axis: int = -1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1):
+    g = jax.random.gumbel(_random.site_key(), x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                axis=axis, dtype=y.dtype)
+        y = onehot + y - lax.stop_gradient(y)  # straight-through estimator
+    return y
+
+
+def hardshrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardsigmoid(x, slope: float = 1.0 / 6.0, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(x, min, max)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def maxout(x, groups: int, axis: int = 1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    shape = (x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:])
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+          training: bool = True):
+    if training:
+        slope = jax.random.uniform(_random.site_key(), x.shape,
+                                   jnp.float32, lower, upper).astype(x.dtype)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def selu(x, scale: float = 1.0507009873554805,
+         alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+def softshrink(x, threshold: float = 0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold: float = 1.0, value: float = 0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+# ---------------------------------------------------------------------------
+# losses (reduction= semantics shared via _reduce)
+# ---------------------------------------------------------------------------
+
+def _reduce(loss, reduction: str):
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+    loss = -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction: str = "mean",
+                                     pos_weight=None):
+    z = logit.astype(jnp.float32)
+    y = label.astype(jnp.float32)
+    # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the
+    # positive term
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * y + 1.0
+        loss = (1.0 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z))
+                                        + jnp.maximum(-z, 0.0))
+    else:
+        loss = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean"):
+    sim = cosine_similarity(input1, input2, axis=-1)
+    loss = jnp.where(label > 0, 1.0 - sim, jnp.maximum(0.0, sim - margin))
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """input: (N, ..., C) probabilities; label: (N, ..., 1) class ids."""
+    label_oh = jax.nn.one_hot(jnp.squeeze(label, -1), input.shape[-1],
+                              dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * label_oh, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(label_oh,
+                                                       axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean"):
+    loss = jnp.where(label > 0, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction: str = "mean", log_target: bool = False):
+    """input: log-probabilities; label: probabilities (paddle convention)."""
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.where(label > 0, label, 1.0)
+        loss = jnp.where(label > 0, label * (jnp.log(safe) - input), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def l1_loss(input, label, reduction: str = "mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def log_loss(input, label, epsilon: float = 1e-4):
+    x = jnp.clip(input, epsilon, 1.0 - epsilon)
+    return -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean"):
+    """input: (N, C, ...) log-probabilities."""
+    nclass = input.shape[1]
+    lbl = jnp.clip(label, 0, nclass - 1)
+    picked = jnp.take_along_axis(input, lbl[:, None], axis=1).squeeze(1)
+    w = (jnp.ones((nclass,), input.dtype) if weight is None
+         else jnp.asarray(weight, input.dtype))
+    wsel = w[lbl]
+    mask = (label != ignore_index).astype(input.dtype)
+    loss = -picked * wsel * mask
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel * mask), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input: bool = True,
+                     full: bool = False, epsilon: float = 1e-8,
+                     reduction: str = "mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2.0 * jnp.pi * label))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    a_t = alpha * label + (1.0 - alpha) * (1.0 - label)
+    loss = a_t * ((1.0 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction: str = "mean"):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6,
+                        swap: bool = False, reduction: str = "mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+
+# ---------------------------------------------------------------------------
+# normalisation
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    """Functional batch norm. In training mode, batch statistics are used;
+    the *updated running stats are returned as aux* (functional style —
+    jax has no in-place buffers; nn.BatchNorm owns the state threading)."""
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else -1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis % x.ndim)
+    xf = x.astype(jnp.float32)
+    if training:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+    else:
+        mean, var = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[ch_axis % x.ndim] = x.shape[ch_axis % x.ndim]
+    y = (xf - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32).reshape(shape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(shape)
+    return y.astype(x.dtype)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, epsilon: float = 1e-5,
+                  data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32).reshape(shape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(shape)
+    y = y.astype(x.dtype)
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+def local_response_norm(x, size: int, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0,
+                        data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    sq = jnp.square(x)
+    half = size // 2
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    acc = lax.reduce_window(jnp.pad(sq, pad), 0.0, lax.add,
+                            (1, size) + (1,) * (x.ndim - 2),
+                            (1,) * x.ndim, "VALID")
+    y = x / jnp.power(k + alpha * acc / size, beta)
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+def normalize(x, p: float = 2.0, axis: int = 1, epsilon: float = 1e-12):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+# ---------------------------------------------------------------------------
+# conv (1d/3d + transposes) — all expressed over lax.conv_general_dilated;
+# transposed convs use lhs_dilation (the fractionally-strided formulation),
+# which XLA pattern-matches back onto the MXU conv path.
+# ---------------------------------------------------------------------------
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd):
+    spatial = "DHW"[3 - nd:]
+    dn = (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}")
+    stride = _tup(stride, nd)
+    dilation = _tup(dilation, nd)
+    if isinstance(padding, str):
+        pad_arg = padding.upper()
+    else:
+        p = _tup(padding, nd)
+        pad_arg = [(pi, pi) for pi in p]
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad_arg,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd).astype(y.dtype)
+    return y
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCL"):
+    if data_format == "NLC":
+        x = jnp.moveaxis(x, -1, 1)
+    y = _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NLC" else y
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCDHW"):
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    y = _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NDHWC" else y
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd):
+    """Transposed conv: input dilation by stride + flipped kernel.
+    weight layout (in_c, out_c/groups, *k) — paddle's transpose layout."""
+    stride = _tup(stride, nd)
+    dilation = _tup(dilation, nd)
+    p = _tup(padding, nd)
+    op = _tup(output_padding, nd)
+    # (I, O/g, *k) -> (O, I/g, *k): swap + regroup for grouped transpose
+    in_c = weight.shape[0]
+    w = weight.reshape((groups, in_c // groups) + weight.shape[1:])
+    w = jnp.swapaxes(w, 1, 2)              # (g, O/g, I/g, *k)
+    w = w.reshape((w.shape[0] * w.shape[1],) + w.shape[2:])  # (O, I/g, *k)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    k = weight.shape[2:]
+    pad_arg = [(dilation[i] * (k[i] - 1) - p[i],
+                dilation[i] * (k[i] - 1) - p[i] + op[i]) for i in range(nd)]
+    spatial = "DHW"[3 - nd:]
+    dn = (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}")
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad_arg,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        feature_group_count=groups, dimension_numbers=dn,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd).astype(y.dtype)
+    return y
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups: int = 1, dilation=1,
+                     data_format: str = "NCL"):
+    if data_format == "NLC":
+        x = jnp.moveaxis(x, -1, 1)
+    y = _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NLC" else y
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups: int = 1, dilation=1,
+                     data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    y = _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NHWC" else y
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups: int = 1, dilation=1,
+                     data_format: str = "NCDHW"):
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    y = _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NDHWC" else y
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _pool_nd(x, kernel, stride, padding, nd, op, init):
+    k = _tup(kernel, nd)
+    s = _tup(stride, nd) if stride is not None else k
+    p = _tup(padding, nd)
+    win = (1, 1) + k
+    str_ = (1, 1) + s
+    pad_ = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    return lax.reduce_window(x, init, op, win, str_, pad_), win, str_, pad_
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    out, *_ = _pool_nd(x, kernel_size, stride, padding, 1, lax.max, -jnp.inf)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    out, *_ = _pool_nd(x, kernel_size, stride, padding, 3, lax.max, -jnp.inf)
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0,
+               exclusive: bool = True):
+    num, win, str_, pad_ = _pool_nd(x, kernel_size, stride, padding, 1,
+                                    lax.add, 0.0)
+    den = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win, str_, pad_)
+    return num / den
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0,
+               exclusive: bool = True):
+    num, win, str_, pad_ = _pool_nd(x, kernel_size, stride, padding, 3,
+                                    lax.add, 0.0)
+    den = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win, str_, pad_)
+    return num / den
+
+
+def _adaptive_pool(x, output_size, nd, reduce_fn):
+    """Adaptive pooling via per-output-bin masked reduction: bin i spans
+    [floor(i*L/O), ceil((i+1)*L/O)) exactly as the reference computes it."""
+    out_sizes = _tup(output_size, nd)
+    y = x
+    for d in range(nd):
+        axis = 2 + d
+        L, O = y.shape[axis], out_sizes[d]
+        starts = (jnp.arange(O) * L) // O
+        ends = -((-(jnp.arange(O) + 1) * L) // O)        # ceil div
+        pos = jnp.arange(L)
+        mask = (pos[None, :] >= starts[:, None]) & (pos[None, :] < ends[:, None])
+        y = jnp.moveaxis(y, axis, -1)
+        y = reduce_fn(y, mask, (ends - starts).astype(y.dtype))
+        y = jnp.moveaxis(y, -1, axis)
+    return y
+
+
+def _adaptive_avg(y, mask, counts):
+    return jnp.einsum("...l,ol->...o", y, mask.astype(y.dtype)) / counts
+
+
+def _adaptive_max(y, mask, counts):
+    expanded = jnp.where(mask, y[..., None, :], -jnp.inf)
+    return jnp.max(expanded, axis=-1)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_pool(x, output_size, 1, _adaptive_avg)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    y = _adaptive_pool(x, output_size, 2, _adaptive_avg)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NHWC" else y
+
+
+def adaptive_avg_pool3d(x, output_size, data_format: str = "NCDHW"):
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    y = _adaptive_pool(x, output_size, 3, _adaptive_avg)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NDHWC" else y
+
+
+def adaptive_max_pool1d(x, output_size):
+    return _adaptive_pool(x, output_size, 1, _adaptive_max)
+
+
+def adaptive_max_pool2d(x, output_size):
+    return _adaptive_pool(x, output_size, 2, _adaptive_max)
+
+
+# ---------------------------------------------------------------------------
+# vision / layout
+# ---------------------------------------------------------------------------
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    r = upscale_factor
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    y = x.reshape(n, c // (r * r), r, r, h, w)
+    y = jnp.transpose(y, (0, 1, 4, 2, 5, 3)).reshape(
+        n, c // (r * r), h * r, w * r)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NHWC" else y
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
+    r = downscale_factor
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // r, r, w // r, r)
+    y = jnp.transpose(y, (0, 1, 3, 5, 2, 4)).reshape(
+        n, c * r * r, h // r, w // r)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NHWC" else y
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    y = x.reshape(n, groups, c // groups, h, w)
+    y = jnp.swapaxes(y, 1, 2).reshape(n, c, h, w)
+    return jnp.moveaxis(y, 1, -1) if data_format == "NHWC" else y
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — adjoint of unfold, expressed as scatter-add of patches."""
+    oh, ow = _tup(output_sizes, 2)
+    kh, kw = _tup(kernel_sizes, 2)
+    sh, sw = _tup(strides, 2)
+    ph, pw = _tup(paddings, 2)
+    dh, dw = _tup(dilations, 2)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    patches = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    ii = (jnp.arange(nh) * sh)[:, None, None, None] + \
+        (jnp.arange(kh) * dh)[None, None, :, None]
+    jj = (jnp.arange(nw) * sw)[None, :, None, None] + \
+        (jnp.arange(kw) * dw)[None, None, None, :]
+    ii = jnp.broadcast_to(ii, (nh, nw, kh, kw))
+    jj = jnp.broadcast_to(jj, (nh, nw, kh, kw))
+    vals = jnp.transpose(patches, (0, 1, 4, 5, 2, 3))   # (n, c, nh, nw, kh, kw)
+    out = out.at[:, :, ii, jj].add(vals)
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """theta: (N, 2, 3); out_shape (N, C, H, W) → grid (N, H, W, 2)."""
+    n, _, h, w = out_shape
+
+    def coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        return (jnp.arange(size) * 2.0 + 1.0) / size - 1.0
+
+    ys = coords(h)
+    xs = coords(w)
+    xg, yg = jnp.meshgrid(xs, ys)                    # (H, W)
+    base = jnp.stack([xg, yg, jnp.ones_like(xg)], axis=-1)  # (H, W, 3)
+    return jnp.einsum("nij,hwj->nhwi", theta, base)  # (N, H, W, 2)
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True):
+    """x: (N, C, H, W); grid: (N, Hg, Wg, 2) in [-1, 1] (x then y)."""
+    n, c, h, w = x.shape
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1.0) * (size - 1) / 2.0
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    gx = unnorm(grid[..., 0], w)
+    gy = unnorm(grid[..., 1], h)
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0.0, w - 1)
+        gy = jnp.clip(gy, 0.0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                v = jnp.abs(jnp.mod(v, span))
+                return jnp.where(v > size - 1, span - v, v)
+            span = 2 * size
+            v = jnp.mod(jnp.abs(v + 0.5), span)
+            v = jnp.where(v > size, span - v, v) - 0.5
+            return jnp.clip(v, 0.0, size - 1)
+        gx = reflect(gx, w)
+        gy = reflect(gy, h)
+
+    def gather2d(img, yi, xi, valid):
+        yi_c = jnp.clip(yi, 0, h - 1)
+        xi_c = jnp.clip(xi, 0, w - 1)
+        vals = img[:, yi_c, xi_c]                    # (C, Hg, Wg)
+        return jnp.where(valid[None], vals, 0.0)
+
+    def sample_one(img, gx1, gy1):
+        if mode == "nearest":
+            xi = jnp.round(gx1).astype(jnp.int32)
+            yi = jnp.round(gy1).astype(jnp.int32)
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h) \
+                if padding_mode == "zeros" else jnp.ones_like(xi, bool)
+            return gather2d(img, yi, xi, valid)
+        x0 = jnp.floor(gx1)
+        y0 = jnp.floor(gy1)
+        wx = gx1 - x0
+        wy = gy1 - y0
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        acc = 0.0
+        for dy_, dx_, wgt in [(0, 0, (1 - wy) * (1 - wx)),
+                              (0, 1, (1 - wy) * wx),
+                              (1, 0, wy * (1 - wx)),
+                              (1, 1, wy * wx)]:
+            yi = y0i + dy_
+            xi = x0i + dx_
+            valid = ((xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                     if padding_mode == "zeros"
+                     else jnp.ones_like(xi, bool))
+            acc = acc + wgt[None] * gather2d(img, yi, xi, valid)
+        return acc
+
+    return jax.vmap(sample_one)(x, gx, gy)
+
+
+def upsample(x, size=None, scale_factor=None, mode: str = "nearest",
+             align_corners: bool = False, data_format: str = "NCHW"):
+    from .functional import interpolate
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW"):
+    l, r, t, b = padding
+    pad = ([(0, 0), (0, 0), (t, b), (l, r)] if data_format == "NCHW"
+           else [(0, 0), (t, b), (l, r), (0, 0)])
+    return jnp.pad(x, pad)
+
+
+# ---------------------------------------------------------------------------
+# dropout variants / misc
+# ---------------------------------------------------------------------------
+
+def dropout2d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCHW"):
+    from .functional import dropout
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, training=training, axis=axis)
+
+
+def dropout3d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCDHW"):
+    from .functional import dropout
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, training=training, axis=axis)
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True):
+    """SELU-preserving dropout (fixed-point mean/var under alpha', as in
+    the reference)."""
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = jax.random.bernoulli(_random.site_key(), 1.0 - p, x.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1):
+    k = label.shape[-1]
+    if prior_dist is None:
+        return (1.0 - epsilon) * label + epsilon / k
+    return (1.0 - epsilon) * label + epsilon * prior_dist
+
+
+def sequence_mask(lengths, maxlen=None, dtype="bool"):
+    maxlen = int(jnp.max(lengths)) if maxlen is None else maxlen
+    mask = jnp.arange(maxlen)[None, :] < jnp.asarray(lengths)[..., None]
+    from ..framework.dtype import to_jax_dtype
+    return mask.astype(to_jax_dtype(dtype))

@@ -23,6 +23,9 @@ from jax import lax
 from .. import amp as _amp
 from ..framework import random as _random
 
+from ._functional_breadth import *  # noqa: F401,F403  (round-4 breadth)
+from ._functional_breadth import __all__ as _breadth_all
+
 __all__ = [
     "linear", "embedding", "relu", "gelu", "silu", "swish", "sigmoid",
     "tanh", "softmax", "log_softmax", "softplus", "leaky_relu", "swiglu",
@@ -32,7 +35,7 @@ __all__ = [
     "smooth_l1_loss",
     "scaled_dot_product_attention", "conv2d", "max_pool2d", "avg_pool2d",
     "pad", "unfold", "interpolate",
-]
+] + list(_breadth_all)
 
 
 # ---------------------------------------------------------------------------

@@ -16,6 +16,12 @@ Measured (v5e, 2026-07, 50-iter mean; speedup = XLA/Pallas wall time):
   (8192, 8192)  bf16  1.05x      (8192, 4096)  bf16  0.98x
 The default threshold (32768) routes only the unambiguous-win region;
 everything below stays on XLA.
+
+Reproducible from the repo (round-3 verdict #7): ``python bench.py --op
+rms_norm`` re-runs this table (jit-wrapped loops, block on output, XLA
+memory_analysis alongside wall time), re-derives the threshold, and
+records everything in ``BENCH_OPS.json`` — the artifact these numbers are
+pinned by.
 """
 
 from __future__ import annotations

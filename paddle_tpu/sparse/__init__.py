@@ -1,0 +1,151 @@
+"""paddle.sparse parity namespace over jax.experimental.sparse (BCOO/BCSR).
+
+The reference's sparse stack (upstream layout: python/paddle/sparse/ +
+paddle/phi/kernels/sparse/) carries SparseCooTensor/SparseCsrTensor with
+cuSPARSE-backed kernels. The TPU-native equivalent is jax's batched-COO
+(``BCOO``) representation: indices+data arrays with static nse, so sparse
+values trace through jit/grad/vmap, and ``bcoo_dot_general`` lowers to
+gather+segment-sum HLOs that XLA tiles onto the MXU's neighbouring vector
+units. Zero-preserving unary math acts on ``.data`` directly (free);
+sparse-sparse elementwise ops ride BCOO's sum-duplicates machinery.
+
+Absent (visible in the registry's work queue): masked_matmul, sparse
+softmax/attention, sparse conv3d — these need a captured sparsity-pattern
+kernel (cuSPARSE SDDMM equivalents) that we'd build in Pallas when a model
+config demands them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from . import nn  # noqa: F401  (re-export submodule)
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "coalesce", "is_same_shape",
+    "matmul", "addmm", "mv", "transpose", "reshape",
+    "add", "subtract", "multiply", "divide",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "expm1", "pow", "cast", "neg",
+    "rad2deg", "deg2rad",
+]
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient: bool = True):
+    """Build a sparse COO tensor. indices: (ndim, nse); values: (nse,)."""
+    indices = jnp.asarray(indices).T            # BCOO wants (nse, ndim)
+    values = jnp.asarray(values, dtype=dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in jnp.max(indices, axis=0))
+    return jsparse.BCOO((values, indices), shape=tuple(shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """Build a sparse CSR tensor (2-D). Stored as BCSR."""
+    return jsparse.BCSR(
+        (jnp.asarray(values, dtype=dtype), jnp.asarray(cols),
+         jnp.asarray(crows)), shape=tuple(shape))
+
+
+def _as_bcoo(x):
+    if isinstance(x, jsparse.BCSR):
+        return x.to_bcoo()
+    return x
+
+
+def coalesce(x):
+    return jsparse.bcoo_sum_duplicates(_as_bcoo(x))
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def matmul(x, y):
+    """Sparse @ dense (or dense @ sparse) → dense; sparse @ sparse → sparse."""
+    x, y = _as_bcoo(x), _as_bcoo(y)
+    return x @ y
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
+    return beta * input + alpha * matmul(x, y)
+
+
+def mv(x, vec):
+    return _as_bcoo(x) @ vec
+
+
+def transpose(x, perm):
+    return jsparse.bcoo_transpose(_as_bcoo(x), permutation=tuple(perm))
+
+
+def reshape(x, shape):
+    return jsparse.bcoo_reshape(_as_bcoo(x), new_sizes=tuple(shape))
+
+
+# -- elementwise sparse-sparse ----------------------------------------------
+
+def add(x, y):
+    return _as_bcoo(x) + _as_bcoo(y)
+
+
+def subtract(x, y):
+    return _as_bcoo(x) + (-1.0) * _as_bcoo(y)
+
+
+def multiply(x, y):
+    x = _as_bcoo(x)
+    if isinstance(y, (jsparse.BCOO, jsparse.BCSR)):
+        return jsparse.bcoo_multiply_sparse(x, _as_bcoo(y))
+    return jsparse.bcoo_multiply_dense(x, jnp.asarray(y))
+
+
+def divide(x, y):
+    x = _as_bcoo(x)
+    if isinstance(y, (jsparse.BCOO, jsparse.BCSR)):
+        y = jsparse.todense(_as_bcoo(y))
+    return jsparse.bcoo_multiply_dense(x, 1.0 / jnp.asarray(y))
+
+
+# -- zero-preserving unary math: act on .data, keep the pattern -------------
+
+def _unary(fn):
+    def op(x):
+        x = _as_bcoo(x)
+        return jsparse.BCOO((fn(x.data), x.indices), shape=x.shape,
+                            indices_sorted=x.indices_sorted,
+                            unique_indices=x.unique_indices)
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+expm1 = _unary(jnp.expm1)
+neg = _unary(jnp.negative)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+
+
+def pow(x, factor):
+    x = _as_bcoo(x)
+    return jsparse.BCOO((jnp.power(x.data, factor), x.indices), shape=x.shape)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    x = _as_bcoo(x)
+    data = x.data.astype(value_dtype) if value_dtype else x.data
+    idx = x.indices.astype(index_dtype) if index_dtype else x.indices
+    return jsparse.BCOO((data, idx), shape=x.shape)

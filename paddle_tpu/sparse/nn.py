@@ -1,0 +1,27 @@
+"""paddle.sparse.nn.functional parity: zero-preserving activations on BCOO."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+__all__ = ["relu", "relu6", "leaky_relu"]
+
+
+def _unary(fn):
+    def op(x, *args):
+        if isinstance(x, jsparse.BCSR):
+            x = x.to_bcoo()
+        return jsparse.BCOO((fn(x.data, *args), x.indices), shape=x.shape,
+                            indices_sorted=x.indices_sorted,
+                            unique_indices=x.unique_indices)
+    return op
+
+
+relu = _unary(jax.nn.relu)
+relu6 = _unary(jax.nn.relu6)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return _unary(lambda d: jax.nn.leaky_relu(d, negative_slope))(x)

@@ -16,6 +16,8 @@ __all__ = [
     "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
     "empty", "empty_like", "arange", "linspace", "logspace", "eye",
     "tril", "triu", "diag", "diagflat", "meshgrid", "clone", "assign",
+    # breadth (round 4)
+    "complex", "polar", "tril_indices", "triu_indices",
 ]
 
 
@@ -116,3 +118,32 @@ def assign(x, output=None):
         raise ValueError("assign(output=) in-place form is not supported on "
                          "immutable jax arrays; use the return value")
     return out
+
+
+# -- breadth (round 4) -------------------------------------------------------
+
+def complex(real, imag):
+    return jax.lax.complex(jnp.asarray(real, jnp.float32)
+                           if jnp.asarray(real).dtype not in
+                           (jnp.float32, jnp.float64)
+                           else jnp.asarray(real),
+                           jnp.asarray(imag, jnp.float32)
+                           if jnp.asarray(imag).dtype not in
+                           (jnp.float32, jnp.float64)
+                           else jnp.asarray(imag))
+
+
+def polar(abs, angle):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def tril_indices(row: int, col=None, offset: int = 0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(to_jax_dtype(dtype))
+
+
+def triu_indices(row: int, col=None, offset: int = 0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(to_jax_dtype(dtype))

@@ -11,6 +11,9 @@ __all__ = [
     "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
     "bitwise_not", "bitwise_xor", "isnan", "isinf", "isfinite", "is_empty",
     "where",
+    # breadth (round 4)
+    "bitwise_left_shift", "bitwise_right_shift", "isposinf", "isneginf",
+    "isreal", "is_complex", "is_floating_point", "is_integer",
 ]
 
 
@@ -105,3 +108,37 @@ def where(condition, x=None, y=None):
         return tuple(jnp.asarray(i)
                      for i in np.where(np.asarray(condition)))
     return jnp.where(condition, x, y)
+
+
+# -- breadth (round 4) -------------------------------------------------------
+
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def is_complex(x):
+    return jnp.iscomplexobj(x)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)

@@ -242,3 +242,137 @@ def as_strided(x, shape, stride, offset: int = 0):
 
 def masked_fill(x, mask, value):
     return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+# -- breadth (round 4): remaining documented manipulation surface ------------
+
+def atleast_1d(*xs):
+    out = [jnp.atleast_1d(jnp.asarray(x)) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*xs):
+    out = [jnp.atleast_2d(jnp.asarray(x)) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*xs):
+    out = [jnp.atleast_3d(jnp.asarray(x)) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def as_complex(x):
+    """(..., 2) real pairs → (...) complex."""
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    """(...) complex → (..., 2) real pairs."""
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def block_diag(inputs):
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+def column_stack(x):
+    return jnp.column_stack(x)
+
+
+def row_stack(x):
+    return jnp.vstack(x)
+
+
+def hstack(x):
+    return jnp.hstack(x)
+
+
+def vstack(x):
+    return jnp.vstack(x)
+
+
+def dstack(x):
+    return jnp.dstack(x)
+
+
+def crop(x, shape, offsets=None):
+    offsets = [0] * x.ndim if offsets is None else list(offsets)
+    # paddle semantics: -1/None = "from offset to the end of the dim"
+    shape = [x.shape[i] - offsets[i] if s in (-1, None) else s
+             for i, s in enumerate(shape)]
+    for i, (o, s) in enumerate(zip(offsets, shape)):
+        if o < 0 or s < 0 or o + s > x.shape[i]:
+            # dynamic_slice would silently clamp; surface the bad crop
+            raise ValueError(
+                f"crop dim {i}: offset {o} + size {s} out of range for "
+                f"input extent {x.shape[i]}")
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def tensor_split(x, num_or_indices, axis: int = 0):
+    return jnp.array_split(x, num_or_indices, axis=axis)
+
+
+def hsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    new = list(x.shape[:axis]) + list(shape) + list(x.shape[axis + 1:])
+    # one -1 wildcard allowed, as in paddle
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        new[new.index(-1)] = x.shape[axis] // known
+    return jnp.reshape(x, new)
+
+
+def unique_consecutive(x, return_inverse: bool = False,
+                       return_counts: bool = False, axis=None):
+    """Deduplicate consecutive runs (host-eager: output shape is data-
+    dependent, same constraint as paddle's dynamic-shape op on XLA)."""
+    import numpy as np
+    xn = np.asarray(x)
+    if axis is None:
+        xn = xn.ravel()
+        axis = 0
+    moved = np.moveaxis(xn, axis, 0)
+    keep = np.ones(moved.shape[0], dtype=bool)
+    if moved.shape[0] > 1:
+        keep[1:] = np.any(
+            moved[1:].reshape(moved.shape[0] - 1, -1)
+            != moved[:-1].reshape(moved.shape[0] - 1, -1), axis=1)
+    out = jnp.asarray(np.moveaxis(moved[keep], 0, axis))
+    results = [out]
+    if return_inverse:
+        results.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        starts = np.flatnonzero(keep)
+        counts = np.diff(np.append(starts, moved.shape[0]))
+        results.append(jnp.asarray(counts))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def masked_scatter(x, mask, value):
+    """Fill mask positions from value's leading elements, row-major.
+
+    Static-shape formulation: position k in the flattened output takes
+    value[rank(k)] where rank = cumsum(mask) - 1; non-mask slots keep x.
+    """
+    mask = jnp.broadcast_to(jnp.asarray(mask), x.shape)
+    flat_mask = mask.ravel()
+    ranks = jnp.cumsum(flat_mask) - 1
+    vals = jnp.ravel(value)[jnp.clip(ranks, 0, None)]
+    out = jnp.where(flat_mask, vals.astype(x.dtype), x.ravel())
+    return out.reshape(x.shape)

@@ -28,6 +28,13 @@ __all__ = [
     "amax", "amin", "cumsum", "cumprod", "cummax", "cummin", "logsumexp",
     "logcumsumexp", "count_nonzero", "all", "any", "diff", "trace",
     "stanh", "trapezoid", "vander",
+    # breadth (round 4): the rest of the documented paddle math surface
+    "addmm", "bincount", "cdist", "combinations", "copysign",
+    "cumulative_trapezoid", "diag_embed", "diagonal", "frexp", "gammainc",
+    "gammaincc", "gammaln", "gcd", "hypot", "i0", "i0e", "i1", "i1e",
+    "index_add", "index_fill", "index_put", "kron", "lcm", "ldexp",
+    "logaddexp", "multigammaln", "nextafter", "polygamma", "renorm", "sgn",
+    "sinc", "take", "tensordot",
 ]
 
 
@@ -457,3 +464,211 @@ def vander(x, n=None, increasing: bool = False):
     n = x.shape[0] if n is None else n
     powers = jnp.arange(n) if increasing else jnp.arange(n - 1, -1, -1)
     return x[:, None] ** powers[None, :]
+
+
+# -- breadth (round 4): remaining documented math surface --------------------
+# (upstream python/paddle/tensor/math.py; jnp/lax give the math directly,
+# the work here is paddle's calling conventions.)
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def bincount(x, weights=None, minlength: int = 0):
+    # jnp.bincount needs a static length; paddle's output length is
+    # max(minlength, max(x)+1), resolved eagerly (host sync).  Inside jit
+    # the max is a tracer, so minlength alone sizes the output — pass a
+    # large-enough minlength there (values above it are DROPPED by the
+    # static-shape clip, the documented jit caveat).
+    import jax.core as _core
+    length = minlength
+    if not isinstance(x, _core.Tracer):
+        m = int(jnp.max(x)) + 1 if x.size else 0
+        length = m if m > minlength else minlength   # builtin max is shadowed
+    return jnp.bincount(x, weights=weights, minlength=length,
+                        length=length)
+
+
+def cdist(x, y, p: float = 2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    if p == 0.0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def combinations(x, r: int = 2, with_replacement: bool = False):
+    import itertools
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = jnp.asarray(list(gen), dtype=jnp.int32).reshape(-1, r)
+    return x[idx]
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis: int = -1):
+    y = jnp.asarray(y)
+    y0 = jnp.take(y, jnp.arange(y.shape[axis] - 1), axis=axis)
+    y1 = jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = (jnp.take(x, jnp.arange(1, x.shape[axis]), axis=axis)
+             - jnp.take(x, jnp.arange(x.shape[axis] - 1), axis=axis))
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum(0.5 * d * (y0 + y1), axis=axis)
+
+
+def diag_embed(x, offset: int = 0, dim1: int = -2, dim2: int = -1):
+    n = x.shape[-1] + (offset if offset >= 0 else -offset)
+    k = x.shape[-1]
+    out = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    rows = jnp.arange(k) + (0 if offset >= 0 else -offset)
+    cols = jnp.arange(k) + (offset if offset >= 0 else 0)
+    out = out.at[..., rows, cols].set(x)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    dim1 = dim1 % nd
+    dim2 = dim2 % nd
+    if (dim1, dim2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (dim1, dim2))
+    return out
+
+
+def diagonal(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def frexp(x):
+    return jnp.frexp(x)
+
+
+def gammainc(x, y):
+    return jax.scipy.special.gammainc(x, y)
+
+
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def index_add(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+def index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def index_put(x, indices, value, accumulate: bool = False):
+    indices = tuple(indices)
+    return (x.at[indices].add(value) if accumulate
+            else x.at[indices].set(value))
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def multigammaln(x, p: int):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def polygamma(x, n: int):
+    # paddle's argument order is (x, n); jax's is (n, x)
+    return jax.scipy.special.polygamma(n, x)
+
+
+def renorm(x, p: float, axis: int, max_norm: float):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def sgn(x):
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1.0, mag))
+    return jnp.sign(x)
+
+
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def take(x, index, mode: str = "raise"):
+    flat = jnp.ravel(x)
+    index = jnp.asarray(index)
+    if mode == "wrap":
+        index = jnp.mod(index, flat.shape[0])
+    else:  # 'raise' can't raise inside jit; clip matches XLA gather semantics
+        index = jnp.clip(index, -flat.shape[0], flat.shape[0] - 1)
+    return flat[index]
+
+
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return jnp.tensordot(x, y, axes=axes)

@@ -20,6 +20,8 @@ __all__ = [
     "rand", "randn", "randint", "randperm", "uniform", "normal",
     "standard_normal", "bernoulli", "multinomial", "poisson", "exponential",
     "shuffle",
+    # breadth (round 4)
+    "log_normal", "binomial", "standard_gamma",
 ]
 
 
@@ -94,3 +96,22 @@ def exponential(x, key=None):
 def shuffle(x, axis: int = 0, key=None):
     return jax.random.permutation(_key(key), x, axis=axis,
                                   independent=False)
+
+
+# -- breadth (round 4) -------------------------------------------------------
+
+def log_normal(mean=1.0, std=2.0, shape=(1,), key=None):
+    """paddle.log_normal: exp of a Normal(mean, std) draw."""
+    return jnp.exp(mean + std * jax.random.normal(_key(key), tuple(shape)))
+
+
+def binomial(count, prob, key=None):
+    count = jnp.asarray(count)
+    prob = jnp.asarray(prob)
+    shape = jnp.broadcast_shapes(count.shape, prob.shape)
+    return jax.random.binomial(_key(key), count, prob, shape=shape).astype(
+        jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+
+def standard_gamma(x, key=None):
+    return jax.random.gamma(_key(key), jnp.asarray(x))

@@ -71,6 +71,42 @@ class Tensor:
     def detach(self):
         return Tensor(jax.lax.stop_gradient(self.value))
 
+    def tolist(self):
+        return self.value.tolist()
+
+    def numel(self):
+        return self.value.size
+
+    def dim(self):
+        return self.value.ndim
+
+    ndimension = dim
+
+    def element_size(self):
+        return self.value.dtype.itemsize
+
+    def astype(self, dtype):
+        from ..framework.dtype import to_jax_dtype
+        return Tensor(self.value.astype(to_jax_dtype(dtype)))
+
+    def cpu(self):
+        return Tensor(jax.device_put(
+            self.value, jax.devices("cpu")[0]))
+
+    def to(self, *args, **kwargs):
+        """paddle.Tensor.to(dtype) / .to(device): dtype strings cast;
+        device strings re-place via jax.device_put."""
+        out = self.value
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                kind = "cpu" if a == "cpu" else None
+                devs = jax.devices(kind) if kind else jax.devices()
+                out = jax.device_put(out, devs[0])
+            else:
+                from ..framework.dtype import to_jax_dtype
+                out = out.astype(to_jax_dtype(a))
+        return Tensor(out)
+
     # -- shape/dtype --------------------------------------------------------
     @property
     def shape(self):

@@ -1,0 +1,5 @@
+"""paddle.vision parity namespace (detection ops live in .ops)."""
+
+from . import ops  # noqa: F401
+
+__all__ = ["ops"]

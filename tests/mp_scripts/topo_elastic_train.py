@@ -1,0 +1,88 @@
+"""Topology-elastic worker (SURVEY §7 hard part (d)): trains a counter
+with per-step sharded checkpoints, crashes once, and resumes under a
+DIFFERENT world size — the supervisor respawns with restart_nprocs, and
+``checkpoint.load_state_dict`` reshards the old topology's shards onto the
+new mesh.  Prints "DONE start=<resume_step> world=<n>" on success.
+
+The "loss curve" here is the counter ``w``: each step adds 1, so a correct
+resharded resume ends at exactly TOTAL_STEPS regardless of how many
+processes wrote the checkpoint it resumed from.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import paddle_tpu.distributed as dist
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed import checkpoint as ckpt
+
+TOTAL_STEPS = 4
+
+
+def latest_step(workdir):
+    marker = os.path.join(workdir, "latest.txt")
+    if not os.path.exists(marker):
+        return None
+    with open(marker) as f:
+        return int(f.read().strip())
+
+
+def main():
+    workdir = sys.argv[1]
+    restart = int(os.environ["PADDLE_TPU_RESTART_NUM"])
+    crash_step = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    hcg = dist.init_parallel_env()
+    proc = jax.process_index()
+    world = jax.process_count()
+    mesh = hcg.mesh
+
+    last = latest_step(workdir)
+    if last is None:
+        start, w = 0, np.zeros((4, 2), np.float32)
+    else:
+        start = last + 1
+        # reshard-on-load: the checkpoint may have been written by a
+        # different number of processes over a different mesh
+        state = ckpt.load_state_dict(
+            os.path.join(workdir, f"step{last}"),
+            shardings={"w": NamedSharding(mesh, P("dp"))})
+        # the loaded array is global (spans all processes): allgather the
+        # full value for the host-side "train step" arithmetic
+        w = np.asarray(multihost_utils.process_allgather(state["w"],
+                                                         tiled=True))
+
+    for step in range(start, TOTAL_STEPS):
+        w = w + 1.0  # the "train step"
+        sharded = jax.device_put(w, NamedSharding(mesh, P("dp")))
+        # each incarnation writes into its own step directory; stale
+        # same-step dirs from a pre-crash world are removed by rank 0
+        step_dir = os.path.join(workdir, f"step{step}")
+        ckpt.save_state_dict({"w": sharded}, step_dir)
+        multihost_utils.sync_global_devices(f"step{step}")
+        if proc == 0:
+            tmp = os.path.join(workdir, "latest.txt.tmp")
+            with open(tmp, "w") as f:
+                f.write(str(step))
+            os.replace(tmp, os.path.join(workdir, "latest.txt"))
+        multihost_utils.sync_global_devices(f"step{step}_marked")
+        if restart == 0 and step == crash_step and proc == world - 1:
+            os._exit(17)  # simulated host loss after the step-N checkpoint
+
+    assert np.allclose(w, float(TOTAL_STEPS)), w
+    print(f"DONE start={start} world={world} proc={proc}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

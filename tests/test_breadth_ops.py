@@ -1,0 +1,557 @@
+"""Oracle tests for the round-4 op-surface breadth: fft, signal,
+vision.ops, sparse, and the math/manipulation/nn.functional extensions.
+
+Pattern is SURVEY §4's OpTest recipe — every op checked against a NumPy
+(or torch-CPU, where it is the honest reference for layout-heavy ops like
+grid_sample/conv_transpose) oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu
+import paddle_tpu.nn.functional as F
+from paddle_tpu import signal
+from paddle_tpu.tensor import fft as pfft
+from paddle_tpu.tensor import logic as L
+from paddle_tpu.tensor import manipulation as MP
+from paddle_tpu.tensor import math as M
+from paddle_tpu.vision import ops as V
+
+rs = np.random.RandomState(0)
+
+
+# ---------------------------------------------------------------------------
+# fft / signal
+# ---------------------------------------------------------------------------
+
+def test_fft_against_numpy():
+    x = rs.randn(3, 16).astype(np.float32)
+    xj = jnp.asarray(x)
+    np.testing.assert_allclose(np.asarray(pfft.fft(xj)), np.fft.fft(x),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pfft.rfft(xj, norm="ortho")),
+                               np.fft.rfft(x, norm="ortho"),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(pfft.irfft(pfft.rfft(xj), n=16)), x, rtol=1e-4, atol=1e-5)
+    x2 = rs.randn(2, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pfft.fft2(jnp.asarray(x2))),
+                               np.fft.fft2(x2), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pfft.fftfreq(8, d=0.5)),
+                               np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(pfft.fftshift(jnp.arange(6.0))),
+        np.fft.fftshift(np.arange(6.0)))
+    with pytest.raises(ValueError):
+        pfft.fft(xj, norm="bogus")
+
+
+def test_stft_istft_roundtrip_and_torch_parity():
+    x = rs.randn(2, 400).astype(np.float32)
+    w = np.hanning(128).astype(np.float32)
+    S = signal.stft(jnp.asarray(x), 128, hop_length=32, window=jnp.asarray(w))
+    St = torch.stft(torch.tensor(x), 128, hop_length=32,
+                    window=torch.tensor(w), center=True, pad_mode="reflect",
+                    onesided=True, return_complex=True)
+    np.testing.assert_allclose(np.asarray(S), St.numpy(), rtol=1e-3,
+                               atol=1e-3)
+    y = signal.istft(S, 128, hop_length=32, window=jnp.asarray(w),
+                     length=400)
+    # reconstruction is exact where complete frames cover the signal
+    np.testing.assert_allclose(np.asarray(y)[:, :380], x[:, :380],
+                               rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep, sup = [], np.zeros(len(boxes), bool)
+    areas = (np.maximum(boxes[:, 2] - boxes[:, 0], 0)
+             * np.maximum(boxes[:, 3] - boxes[:, 1], 0))
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            u = areas[i] + areas[j] - inter
+            if u > 0 and inter / u > thr:
+                sup[j] = True
+    return np.array(keep)
+
+
+def test_nms_matches_greedy_reference():
+    boxes = rs.rand(40, 4).astype(np.float32) * 50
+    boxes[:, 2:] = boxes[:, :2] + rs.rand(40, 2).astype(np.float32) * 30 + 1
+    scores = rs.rand(40).astype(np.float32)
+    ours = np.asarray(V.nms(jnp.asarray(boxes), 0.4, jnp.asarray(scores)))
+    np.testing.assert_array_equal(ours, _np_nms(boxes, scores, 0.4))
+    # categorical NMS: suppression only within a category
+    cats = jnp.asarray(rs.randint(0, 3, (40,)))
+    kept = np.asarray(V.nms(jnp.asarray(boxes), 0.4, jnp.asarray(scores),
+                            category_idxs=cats, categories=[0, 1, 2]))
+    assert len(kept) >= len(ours)
+
+
+def test_roi_align_bilinear_oracle():
+    feat = rs.randn(1, 3, 16, 16).astype(np.float32)
+    rois = np.array([[2., 2., 10., 12.], [0., 0., 15., 15.]], np.float32)
+    out = np.asarray(V.roi_align(jnp.asarray(feat), jnp.asarray(rois),
+                                 [2], 4, 0.5, 2, True))
+    # naive per-sample-point bilinear reference
+    ref = np.zeros((2, 3, 4, 4), np.float32)
+    off = 0.5
+    for r, box in enumerate(rois):
+        x1, y1, x2, y2 = box * 0.5 - off
+        bh, bw = (y2 - y1) / 4, (x2 - x1) / 4
+        for i in range(4):
+            for j in range(4):
+                acc = np.zeros(3, np.float32)
+                for iy in range(2):
+                    for ix in range(2):
+                        y = y1 + i * bh + (iy + .5) * bh / 2
+                        x = x1 + j * bw + (ix + .5) * bw / 2
+                        y0 = min(max(int(np.floor(y)), 0), 15)
+                        x0 = min(max(int(np.floor(x)), 0), 15)
+                        y1i, x1i = min(y0 + 1, 15), min(x0 + 1, 15)
+                        wy = min(max(y - y0, 0), 1)
+                        wx = min(max(x - x0, 0), 1)
+                        acc += (feat[0][:, y0, x0] * (1 - wy) * (1 - wx)
+                                + feat[0][:, y0, x1i] * (1 - wy) * wx
+                                + feat[0][:, y1i, x0] * wy * (1 - wx)
+                                + feat[0][:, y1i, x1i] * wy * wx)
+                ref[r, :, i, j] = acc / 4
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pool_and_box_coder():
+    feat = rs.randn(1, 3, 16, 16).astype(np.float32)
+    rois = np.array([[2., 2., 10., 12.]], np.float32)
+    out = np.asarray(V.roi_pool(jnp.asarray(feat), jnp.asarray(rois),
+                                [1], 2, 1.0))
+    assert out.shape == (1, 3, 2, 2) and np.isfinite(out).all()
+    x1, y1 = 2, 2
+    np.testing.assert_allclose(
+        out[0, :, 0, 0], feat[0][:, 2:7, 2:6].max((1, 2)), rtol=1e-6)
+
+    prior = np.abs(rs.rand(5, 4).astype(np.float32)) * 10
+    prior[:, 2:] += prior[:, :2] + 1
+    target = np.abs(rs.rand(5, 4).astype(np.float32)) * 10
+    target[:, 2:] += target[:, :2] + 1
+    enc = V.box_coder(jnp.asarray(prior), None, jnp.asarray(target))
+    dec = V.box_coder(jnp.asarray(prior), None, enc, "decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec), target, rtol=1e-3, atol=1e-3)
+
+
+def test_yolo_box_and_prior_box_shapes():
+    xin = jnp.asarray(rs.randn(2, 3 * 9, 4, 4).astype(np.float32))
+    b, s = V.yolo_box(xin, jnp.asarray([[128, 128], [96, 96]]),
+                      [10, 13, 16, 30, 33, 23], 4, 0.01, 32)
+    assert b.shape == (2, 48, 4) and s.shape == (2, 48, 4)
+    assert bool(jnp.all(b[..., 2] >= b[..., 0] - 1e-3))
+    pb, pv = V.prior_box(jnp.zeros((1, 3, 4, 4)), jnp.zeros((1, 3, 32, 32)),
+                         [8.0], [16.0], [2.0], flip=True, clip=True)
+    assert pb.shape == pv.shape == (4, 4, 4, 4)
+    assert bool(jnp.all((pb >= 0) & (pb <= 1)))
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+def test_sparse_coo_csr_against_dense():
+    import paddle_tpu.sparse as sp
+    import paddle_tpu.sparse.nn as spnn
+
+    d = rs.rand(4, 5).astype(np.float32)
+    d[d < 0.6] = 0
+    idx = np.nonzero(d)
+    coo = sp.sparse_coo_tensor(np.stack(idx), d[idx], d.shape)
+    np.testing.assert_allclose(np.asarray(coo.todense()), d)
+
+    w = rs.randn(5, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sp.matmul(coo, jnp.asarray(w))),
+                               d @ w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sp.add(coo, coo).todense()),
+                               2 * d, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sp.multiply(coo, coo).todense()), d * d, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sp.sin(coo).todense()),
+                               np.sin(d), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sp.transpose(coo, [1, 0]).todense()), d.T)
+    np.testing.assert_allclose(np.asarray(spnn.relu(coo).todense()),
+                               np.maximum(d, 0))
+    np.testing.assert_allclose(
+        np.asarray(sp.addmm(jnp.ones((4, 3)), coo, jnp.asarray(w),
+                            0.5, 2.0)),
+        0.5 + 2.0 * (d @ w), rtol=1e-5, atol=1e-5)
+
+    # CSR path (scipy layout as the oracle for the (crows, cols) encoding)
+    crows = np.array([0, *np.cumsum(np.bincount(idx[0], minlength=4))])
+    order = np.lexsort((idx[1], idx[0]))
+    csr = sp.sparse_csr_tensor(crows, idx[1][order], d[idx][order], d.shape)
+    np.testing.assert_allclose(np.asarray(csr.todense()), d)
+    assert sp.is_same_shape(coo, csr)
+
+
+# ---------------------------------------------------------------------------
+# math breadth
+# ---------------------------------------------------------------------------
+
+def test_math_breadth_oracles():
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(4, 5).astype(np.float32)
+    c = rs.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(M.addmm(jnp.asarray(c), jnp.asarray(a), jnp.asarray(b),
+                           beta=0.5, alpha=2.0)),
+        0.5 * c + 2.0 * (a @ b), rtol=1e-5)
+    ints = rs.randint(0, 7, (20,))
+    np.testing.assert_array_equal(np.asarray(M.bincount(jnp.asarray(ints))),
+                                  np.bincount(ints))
+    x1 = rs.randn(4, 3).astype(np.float32)
+    x2 = rs.randn(5, 3).astype(np.float32)
+    ref = torch.cdist(torch.tensor(x1), torch.tensor(x2), p=2).numpy()
+    np.testing.assert_allclose(
+        np.asarray(M.cdist(jnp.asarray(x1), jnp.asarray(x2))), ref,
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(M.diag_embed(jnp.asarray([1.0, 2.0]), offset=1)),
+        np.diag([1.0, 2.0], k=1))
+    np.testing.assert_allclose(
+        np.asarray(M.diagonal(jnp.asarray(a), offset=1)),
+        np.diagonal(a, offset=1))
+    man, exp = M.frexp(jnp.asarray([8.0, 0.5]))
+    np.testing.assert_allclose(np.asarray(man) * 2.0 ** np.asarray(exp),
+                               [8.0, 0.5])
+    np.testing.assert_array_equal(
+        np.asarray(M.gcd(jnp.asarray([12, 18]), jnp.asarray([18, 24]))),
+        [6, 6])
+    np.testing.assert_allclose(
+        np.asarray(M.kron(jnp.eye(2), jnp.ones((2, 2)))),
+        np.kron(np.eye(2), np.ones((2, 2))))
+    np.testing.assert_allclose(
+        np.asarray(M.sinc(jnp.asarray([0.0, 0.5, 1.0]))),
+        np.sinc([0.0, 0.5, 1.0]), rtol=1e-6, atol=1e-7)
+    # polygamma argument order is (x, n)
+    np.testing.assert_allclose(
+        np.asarray(M.polygamma(jnp.asarray([1.0, 2.0]), 1)),
+        torch.polygamma(1, torch.tensor([1.0, 2.0])).numpy(), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(M.i0(jnp.asarray([0.0, 1.0]))),
+        torch.special.i0(torch.tensor([0.0, 1.0])).numpy(), rtol=1e-5)
+    expected = a.copy()
+    expected[[0, 2]] += 1.0
+    np.testing.assert_allclose(
+        np.asarray(M.index_add(jnp.asarray(a), jnp.asarray([0, 2]), 0,
+                               jnp.ones((2, 4)))), expected, rtol=1e-6)
+    filled = a.copy()
+    filled[:, [1, 3]] = -5.0
+    np.testing.assert_allclose(
+        np.asarray(M.index_fill(jnp.asarray(a), jnp.asarray([1, 3]), 1,
+                                -5.0)), filled, rtol=1e-6)
+    t = rs.randn(6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(M.renorm(jnp.asarray(t).reshape(2, 3), 2.0, 0, 1.0)),
+        torch.renorm(torch.tensor(t).reshape(2, 3), 2.0, 0, 1.0).numpy(),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(M.take(jnp.arange(12).reshape(3, 4),
+                          jnp.asarray([0, 5, -1]))), [0, 5, 11])
+    np.testing.assert_allclose(
+        np.asarray(M.tensordot(jnp.asarray(a), jnp.asarray(a), axes=2)),
+        np.tensordot(a, a, axes=2), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(M.cumulative_trapezoid(jnp.asarray(a), dx=0.5)),
+        torch.cumulative_trapezoid(torch.tensor(a), dx=0.5).numpy(),
+        rtol=1e-5)
+
+
+def test_manipulation_breadth_oracles():
+    a = rs.randn(2, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(MP.as_complex(jnp.asarray(a).reshape(2, 3, 2))),
+        a.reshape(2, 3, 2)[..., 0] + 1j * a.reshape(2, 3, 2)[..., 1])
+    z = a.reshape(2, 3, 2)[..., 0] + 1j * a.reshape(2, 3, 2)[..., 1]
+    np.testing.assert_allclose(np.asarray(MP.as_real(jnp.asarray(z))),
+                               np.stack([z.real, z.imag], -1))
+    np.testing.assert_allclose(
+        np.asarray(MP.block_diag([jnp.ones((2, 2)), 2 * jnp.ones((1, 1))])),
+        np.block([[np.ones((2, 2)), np.zeros((2, 1))],
+                  [np.zeros((1, 2)), 2 * np.ones((1, 1))]]))
+    np.testing.assert_allclose(np.asarray(MP.hstack([jnp.asarray(a)] * 2)),
+                               np.hstack([a, a]))
+    parts = MP.tensor_split(jnp.asarray(a), 4, axis=1)
+    ref = np.array_split(a, 4, axis=1)
+    for p, r in zip(parts, ref):
+        np.testing.assert_allclose(np.asarray(p), r)
+    assert MP.unflatten(jnp.asarray(a), 1, [2, -1]).shape == (2, 2, 3)
+    vals, inv, counts = MP.unique_consecutive(
+        jnp.asarray([1, 1, 2, 2, 3, 1]), return_inverse=True,
+        return_counts=True)
+    np.testing.assert_array_equal(np.asarray(vals), [1, 2, 3, 1])
+    np.testing.assert_array_equal(np.asarray(inv), [0, 0, 1, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(counts), [2, 2, 1, 1])
+    out = MP.masked_scatter(jnp.zeros(5),
+                            jnp.asarray([1, 0, 1, 0, 1], bool),
+                            jnp.asarray([7.0, 8.0, 9.0]))
+    np.testing.assert_allclose(np.asarray(out), [7, 0, 8, 0, 9])
+    np.testing.assert_allclose(
+        np.asarray(MP.crop(jnp.asarray(a), [1, 3], [1, 2])), a[1:2, 2:5])
+
+
+def test_logic_breadth():
+    np.testing.assert_array_equal(
+        np.asarray(L.bitwise_left_shift(jnp.asarray([1, 2]),
+                                        jnp.asarray([2, 1]))), [4, 4])
+    assert bool(L.is_floating_point(jnp.ones(1)))
+    assert not bool(L.is_floating_point(jnp.ones(1, jnp.int32)))
+    np.testing.assert_array_equal(
+        np.asarray(L.isposinf(jnp.asarray([1.0, np.inf, -np.inf]))),
+        [False, True, False])
+
+
+# ---------------------------------------------------------------------------
+# nn.functional breadth (torch-CPU oracles for the layout-heavy ops)
+# ---------------------------------------------------------------------------
+
+def test_activation_breadth_against_torch():
+    x = rs.randn(4, 8).astype(np.float32)
+    xt = torch.tensor(x)
+    xj = jnp.asarray(x)
+    cases = [
+        (F.celu(xj), torch.celu(xt)),
+        (F.elu(xj), torch.nn.functional.elu(xt)),
+        (F.glu(xj), torch.nn.functional.glu(xt)),
+        (F.hardshrink(xj), torch.nn.functional.hardshrink(xt)),
+        (F.hardtanh(xj), torch.nn.functional.hardtanh(xt)),
+        (F.log_sigmoid(xj), torch.nn.functional.logsigmoid(xt)),
+        (F.selu(xj), torch.selu(xt)),
+        (F.softshrink(xj), torch.nn.functional.softshrink(xt)),
+        (F.softsign(xj), torch.nn.functional.softsign(xt)),
+        (F.tanhshrink(xj), torch.nn.functional.tanhshrink(xt)),
+    ]
+    for ours, ref in cases:
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(F.maxout(jnp.asarray(x).reshape(2, 4, 4), 2)),
+        x.reshape(2, 2, 2, 4).max(2), rtol=1e-6)
+
+
+def test_loss_breadth_against_torch():
+    x = rs.randn(4, 8).astype(np.float32)
+    lbl = (rs.rand(4, 8) > 0.5).astype(np.float32)
+    xt, lt = torch.tensor(x), torch.tensor(lbl)
+    np.testing.assert_allclose(
+        float(F.binary_cross_entropy_with_logits(jnp.asarray(x),
+                                                 jnp.asarray(lbl))),
+        float(torch.nn.functional.binary_cross_entropy_with_logits(xt, lt)),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        float(F.binary_cross_entropy_with_logits(
+            jnp.asarray(x), jnp.asarray(lbl), pos_weight=jnp.full((8,), 2.0))),
+        float(torch.nn.functional.binary_cross_entropy_with_logits(
+            xt, lt, pos_weight=torch.full((8,), 2.0))), rtol=1e-5)
+    logp = jax.nn.log_softmax(jnp.asarray(x))
+    ids = rs.randint(0, 8, (4,))
+    w = np.abs(rs.rand(8)).astype(np.float32) + 0.1
+    np.testing.assert_allclose(
+        float(F.nll_loss(logp, jnp.asarray(ids), weight=jnp.asarray(w))),
+        float(torch.nn.functional.nll_loss(
+            torch.tensor(np.asarray(logp)), torch.tensor(ids),
+            weight=torch.tensor(w))), rtol=1e-5)
+    probs = jax.nn.softmax(jnp.asarray(x))
+    np.testing.assert_allclose(
+        float(F.kl_div(logp, probs, reduction="batchmean")),
+        float(torch.nn.functional.kl_div(
+            torch.tensor(np.asarray(logp)),
+            torch.tensor(np.asarray(probs)), reduction="batchmean")),
+        rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        float(F.triplet_margin_loss(jnp.asarray(x), jnp.asarray(x + 0.5),
+                                    jnp.asarray(x - 0.2))),
+        float(torch.nn.functional.triplet_margin_loss(
+            xt, xt + 0.5, xt - 0.2)), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(F.margin_ranking_loss(jnp.asarray(x[0]), jnp.asarray(x[1]),
+                                    jnp.asarray(np.sign(x[2])), 0.1)),
+        float(torch.nn.functional.margin_ranking_loss(
+            xt[0], xt[1], torch.sign(xt[2]), margin=0.1)), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(F.poisson_nll_loss(jnp.asarray(x),
+                                 jnp.asarray(np.abs(lbl)))),
+        float(torch.nn.functional.poisson_nll_loss(xt, torch.abs(lt))),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        float(F.soft_margin_loss(jnp.asarray(x),
+                                 jnp.asarray(np.sign(lbl * 2 - 1)))),
+        float(torch.nn.functional.soft_margin_loss(
+            xt, torch.sign(lt * 2 - 1))), rtol=1e-5)
+
+
+def test_norm_breadth_against_torch():
+    img = rs.randn(2, 6, 5, 5).astype(np.float32)
+    it = torch.tensor(img)
+    w = rs.rand(6).astype(np.float32) + 0.5
+    b = rs.randn(6).astype(np.float32)
+    ours = F.batch_norm(jnp.asarray(img), jnp.zeros(6), jnp.ones(6),
+                        jnp.asarray(w), jnp.asarray(b), training=True)
+    ref = torch.nn.functional.batch_norm(
+        it, torch.zeros(6), torch.ones(6), torch.tensor(w),
+        torch.tensor(b), training=True)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.instance_norm(jnp.asarray(img))),
+        torch.nn.functional.instance_norm(it).numpy(), rtol=1e-4,
+        atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.local_response_norm(jnp.asarray(img), 5)),
+        torch.nn.functional.local_response_norm(it, 5).numpy(), rtol=1e-4,
+        atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.normalize(jnp.asarray(img), axis=1)),
+        torch.nn.functional.normalize(it, dim=1).numpy(), rtol=1e-4,
+        atol=1e-5)
+
+
+def test_conv_breadth_against_torch():
+    sig = rs.randn(2, 3, 16).astype(np.float32)
+    w1 = rs.randn(5, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.conv1d(jnp.asarray(sig), jnp.asarray(w1), stride=2,
+                            padding=1)),
+        torch.nn.functional.conv1d(torch.tensor(sig), torch.tensor(w1),
+                                   stride=2, padding=1).numpy(),
+        rtol=1e-4, atol=1e-4)
+    vol = rs.randn(2, 3, 6, 6, 6).astype(np.float32)
+    w3 = rs.randn(4, 3, 2, 2, 2).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.conv3d(jnp.asarray(vol), jnp.asarray(w3))),
+        torch.nn.functional.conv3d(torch.tensor(vol),
+                                   torch.tensor(w3)).numpy(),
+        rtol=1e-4, atol=1e-4)
+    x = rs.randn(2, 4, 7, 7).astype(np.float32)
+    wt = rs.randn(4, 3, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.conv2d_transpose(jnp.asarray(x), jnp.asarray(wt),
+                                      stride=2, padding=1,
+                                      output_padding=1)),
+        torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(wt), stride=2, padding=1,
+            output_padding=1).numpy(), rtol=1e-4, atol=1e-4)
+    # grouped transpose
+    wg = rs.randn(4, 2, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.conv2d_transpose(jnp.asarray(x), jnp.asarray(wg),
+                                      stride=2, groups=2)),
+        torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(wg), stride=2,
+            groups=2).numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_pool_breadth_against_torch():
+    img = rs.randn(2, 6, 9, 7).astype(np.float32)
+    it = torch.tensor(img)
+    np.testing.assert_allclose(
+        np.asarray(F.adaptive_avg_pool2d(jnp.asarray(img), (3, 2))),
+        torch.nn.functional.adaptive_avg_pool2d(it, (3, 2)).numpy(),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(F.adaptive_max_pool2d(jnp.asarray(img), 3)),
+        torch.nn.functional.adaptive_max_pool2d(it, 3).numpy(),
+        rtol=1e-6)
+    sig = rs.randn(2, 3, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.max_pool1d(jnp.asarray(sig), 2)),
+        torch.nn.functional.max_pool1d(torch.tensor(sig), 2).numpy())
+    np.testing.assert_allclose(
+        np.asarray(F.avg_pool1d(jnp.asarray(sig), 2)),
+        torch.nn.functional.avg_pool1d(torch.tensor(sig), 2).numpy(),
+        rtol=1e-6)
+
+
+def test_vision_layout_ops_against_torch():
+    x = rs.randn(1, 8, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.pixel_shuffle(jnp.asarray(x), 2)),
+        torch.nn.functional.pixel_shuffle(torch.tensor(x), 2).numpy())
+    y = rs.randn(1, 2, 6, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.pixel_unshuffle(jnp.asarray(y), 2)),
+        torch.nn.functional.pixel_unshuffle(torch.tensor(y), 2).numpy())
+    c = rs.randn(1, 6, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.channel_shuffle(jnp.asarray(c), 3)),
+        torch.nn.functional.channel_shuffle(torch.tensor(c), 3).numpy())
+    # fold ∘ unfold == identity for non-overlapping patches
+    xi = rs.randn(1, 2, 6, 6).astype(np.float32)
+    cols = F.unfold(jnp.asarray(xi), 2, stride=2)
+    rec = F.fold(cols, (6, 6), 2, strides=2)
+    np.testing.assert_allclose(np.asarray(rec), xi, rtol=1e-6)
+
+
+def test_grid_sample_against_torch():
+    img = rs.randn(2, 6, 5, 5).astype(np.float32)
+    theta = (rs.randn(2, 2, 3).astype(np.float32) * 0.3
+             + np.array([[1, 0, 0], [0, 1, 0]], np.float32))
+    for align in (True, False):
+        grid = F.affine_grid(jnp.asarray(theta), (2, 6, 5, 5),
+                             align_corners=align)
+        gridt = torch.nn.functional.affine_grid(
+            torch.tensor(theta), (2, 6, 5, 5), align_corners=align)
+        np.testing.assert_allclose(np.asarray(grid), gridt.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        for pm in ("zeros", "border"):
+            ours = F.grid_sample(jnp.asarray(img), grid,
+                                 padding_mode=pm, align_corners=align)
+            ref = torch.nn.functional.grid_sample(
+                torch.tensor(img), gridt, padding_mode=pm,
+                align_corners=align)
+            np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_variants_and_misc():
+    paddle_tpu.seed(0)
+    img = jnp.ones((2, 6, 5, 5))
+    d2 = F.dropout2d(img, p=0.5)
+    # channel-wise: each (n, c) slice is all-zero or all-scaled
+    per_chan = np.asarray(d2).reshape(2, 6, -1)
+    assert all(len(np.unique(per_chan[n, c])) == 1
+               for n in range(2) for c in range(6))
+    ad = F.alpha_dropout(jnp.asarray(rs.randn(1000).astype(np.float32)),
+                         p=0.3)
+    assert abs(float(jnp.mean(ad))) < 0.2  # mean approximately preserved
+    mask = F.sequence_mask(jnp.asarray([1, 3, 2]), 4, dtype="int32")
+    np.testing.assert_array_equal(
+        np.asarray(mask), [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+    sm = F.label_smooth(jnp.eye(4), epsilon=0.1)
+    np.testing.assert_allclose(np.asarray(sm).sum(-1), np.ones(4),
+                               rtol=1e-5)
+
+
+def test_tensor_facade_round4_methods():
+    from paddle_tpu.tensor.tensor_facade import Tensor
+
+    t = Tensor(jnp.arange(6.0).reshape(2, 3))
+    assert t.numel() == 6 and t.dim() == 2 and t.ndimension() == 2
+    assert t.element_size() == 4
+    assert t.tolist() == [[0, 1, 2], [3, 4, 5]]
+    assert t.astype("int32").dtype == jnp.int32
+    assert t.to("float32").dtype == jnp.float32
+    assert t.cpu().value.devices() == {jax.devices("cpu")[0]}

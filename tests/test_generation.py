@@ -485,3 +485,32 @@ def test_qwen2_vl_beam_search_tiles_extra_inputs():
                                     num_beams=2))
     assert out.shape == (2, 7)
     assert np.isfinite(out).all()
+
+
+def test_prefill_with_cache_routes_through_flash_kernel():
+    """Round-3 verdict #9: cached prefill (q_len=prompt, pos=0 static)
+    must take the Pallas flash kernel when eligible, and produce the same
+    generation as the all-reference path.  flash_attention_force makes a
+    silent fallback an error, so this test proves the kernel actually ran
+    for the prefill (incremental steps bypass dispatch by design)."""
+    from paddle_tpu import flags
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+
+    cfg = tiny_llama_config(hidden_size=256, intermediate_size=256,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            max_position_embeddings=160)
+    pt.seed(31)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = _prompt(2, 128, vocab=cfg.vocab_size, seed=33)  # kernel-aligned
+
+    ref = np.asarray(model.generate(ids, max_new_tokens=4))
+    model._generate_jit_cache.clear()
+    flags.set_flags({"pallas_interpret": True,
+                     "flash_attention_force": True})
+    try:
+        out = np.asarray(model.generate(ids, max_new_tokens=4))
+    finally:
+        flags.set_flags({"pallas_interpret": False,
+                         "flash_attention_force": False})
+    np.testing.assert_array_equal(ref, out)

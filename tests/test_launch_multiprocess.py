@@ -71,3 +71,42 @@ def test_elastic_gives_up_after_max_restarts(tmp_path):
                        log_dir=str(tmp_path / "logs"))
     rc = elastic_run([sys.executable, "-c", "import sys; sys.exit(3)"], cfg)
     assert rc == 3  # restarted once, then surfaced the failure
+
+
+@pytest.mark.timeout(300)
+def test_topology_elastic_resume_scale_in(tmp_path):
+    """SURVEY §7 hard part (d): crash a 2-process job, resume on ONE
+    process — reshard-on-load composes with the elastic supervisor and the
+    counter 'loss curve' continues exactly."""
+    log_dir = str(tmp_path / "logs")
+    cfg = LaunchConfig(nprocs=2, backend="cpu", devices_per_proc=2,
+                       log_dir=log_dir, max_restarts=1, restart_nprocs=[1])
+    rc = elastic_run(
+        [sys.executable, "-u",
+         os.path.join(SCRIPTS, "topo_elastic_train.py"),
+         str(tmp_path / "work")], cfg)
+    logs = _read_logs(log_dir)
+    assert rc == 0, f"topology-elastic job failed:\n{logs}"
+    done = [l for l in logs.values() if "DONE" in l]
+    assert len(done) == 1, logs                      # one survivor process
+    assert "start=2" in done[0] and "world=1" in done[0], logs
+    assert any(".r0." in name for name in logs), logs
+    assert any(".r1." in name for name in logs), logs
+
+
+@pytest.mark.timeout(300)
+def test_topology_elastic_resume_scale_out(tmp_path):
+    """The reverse direction: a 1-process job crashes and resumes on TWO
+    processes, each loading its half of the single-shard checkpoint."""
+    log_dir = str(tmp_path / "logs")
+    cfg = LaunchConfig(nprocs=1, backend="cpu", devices_per_proc=2,
+                       log_dir=log_dir, max_restarts=1, restart_nprocs=[2])
+    rc = elastic_run(
+        [sys.executable, "-u",
+         os.path.join(SCRIPTS, "topo_elastic_train.py"),
+         str(tmp_path / "work")], cfg)
+    logs = _read_logs(log_dir)
+    assert rc == 0, f"topology-elastic job failed:\n{logs}"
+    done = [l for l in logs.values() if "DONE" in l]
+    assert len(done) == 2, logs
+    assert all("start=2" in l and "world=2" in l for l in done), logs

@@ -160,3 +160,59 @@ def test_close_refuses_while_loader_live(token_bin):
     ds.close()
     with pytest.raises(ValueError, match="positive"):
         native.MMapTokenDataset(path, seq_len=0)
+
+
+# -- round 4: DataLoader integration (verdict #8) -----------------------------
+
+def test_dataloader_routes_mmap_dataset_through_native(token_bin):
+    from paddle_tpu.io import DataLoader
+
+    path, toks = token_bin
+    ds = native.MMapTokenDataset(path, seq_len=33, stride=33)
+    dl = DataLoader(ds, batch_size=8, shuffle=True, num_workers=2, seed=7)
+    assert dl._native_cfg is not None          # fast path engaged
+    dl.set_epoch(2)
+    got = list(dl)
+    want = oracle_batches(toks, 33, 33, batch=8, seed=7, epoch=2,
+                          rank=0, world=1)
+    assert len(got) == len(want) == len(dl)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    # next epoch reshuffles automatically (epoch counter advanced)
+    got3 = list(dl)
+    want3 = oracle_batches(toks, 33, 33, batch=8, seed=7, epoch=3,
+                           rank=0, world=1)
+    for g, w in zip(got3, want3):
+        np.testing.assert_array_equal(g, w)
+    ds.close()
+
+
+def test_dataloader_native_with_distributed_sampler(token_bin):
+    from paddle_tpu.io import DataLoader, DistributedBatchSampler
+
+    path, toks = token_bin
+    ds = native.MMapTokenDataset(path, seq_len=33, stride=33)
+    shards = []
+    for rank in range(2):
+        bs = DistributedBatchSampler(ds, batch_size=4, num_replicas=2,
+                                     rank=rank, shuffle=True)
+        dl = DataLoader(ds, batch_sampler=bs, num_workers=1, seed=5)
+        shards.append(list(dl))
+        want = oracle_batches(toks, 33, 33, batch=4, seed=5, epoch=0,
+                              rank=rank, world=2)
+        for g, w in zip(shards[-1], want):
+            np.testing.assert_array_equal(g, w)
+    seen0 = {tuple(row) for b in shards[0] for row in b}
+    seen1 = {tuple(row) for b in shards[1] for row in b}
+    assert not (seen0 & seen1)                 # disjoint rank shards
+    ds.close()
+
+
+def test_dataloader_native_rejects_plain_batch_sampler(token_bin):
+    from paddle_tpu.io import BatchSampler, DataLoader
+
+    path, _ = token_bin
+    ds = native.MMapTokenDataset(path, seq_len=33)
+    with pytest.raises(ValueError):
+        DataLoader(ds, batch_sampler=BatchSampler(ds, batch_size=4))
+    ds.close()
