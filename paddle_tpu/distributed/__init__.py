@@ -24,7 +24,8 @@ from .parallelize import (build_eval_step, build_train_step,
                           shard_batch, zero_shard_spec)
 from .topology import (AXIS_ORDER, CommunicateTopology,
                        HybridCommunicateGroup, ParallelMode)
-from . import checkpoint, fleet, launch
+from . import checkpoint, fleet, launch, lint
+from .lint import CollectiveOrderError, check_collective_order
 from .checkpoint import load_state_dict, save_state_dict
 from . import moe
 from .context_parallel import context_parallel_attention

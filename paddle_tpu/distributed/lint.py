@@ -1,0 +1,166 @@
+"""Collective-order lint — the SPMD deadlock sanitizer.
+
+TPU-native counterpart of the reference's comm sanitizers (SURVEY §5
+sanitizers row: upstream relies on NCCL watchdog flags like
+FLAGS_nccl_blocking_wait plus the StreamSafeCUDAAllocator's structural
+guarantees; "XLA's checker + a shard_map collective-order lint of our own"
+is the stated TPU design).
+
+Under GSPMD/shard_map every rank runs ONE traced program, so plain
+straight-line code cannot reorder collectives across ranks — the classic
+NCCL mismatched-collective hang is impossible by construction.  The
+residual risk lives in *control flow*:
+
+  * branches of ``lax.cond`` whose collective sequences differ (jax's vma
+    typing already rejects different collective *sets*; the lint also
+    catches same-type-different-comm cases — reordered collectives,
+    mismatched ppermute rings): if the predicate ever diverges across
+    ranks, the program deadlocks on hardware;
+  * a collective inside a ``lax.while_loop``'s *cond* function (the final
+    failing evaluation may disagree across ranks);
+  * a collective inside a while_loop's *body* when the predicate reads
+    ``axis_index`` — a statically-visible rank-divergent trip count, so
+    ranks issue different collective counts.  Body collectives under a
+    rank-uniform predicate are legitimate and pass.
+
+This lint walks the traced jaxpr (through pjit/shard_map/scan/cond/while/
+remat sub-jaxprs), extracts the ordered collective schedule, and raises
+:class:`CollectiveOrderError` on those two patterns.  The schedule itself
+is returned so callers can pin it in tests (a collective-order regression
+is then a visible diff, the reference's "log the NCCL op sequence"
+debugging technique made structural).
+
+Enable at train-step build time with ``FLAGS_collective_lint`` — it runs
+once at trace time, costs nothing per step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+
+__all__ = ["CollectiveOrderError", "collective_schedule",
+           "check_collective_order"]
+
+# primitive names that lower to cross-replica communication ("psum" traces
+# as "psum_invariant" under the vma type system, jax >= 0.8; "pvary" is a
+# type cast, not comm, and is deliberately absent)
+_COLLECTIVE_PRIMS = {
+    "psum", "psum_invariant", "pmax", "pmin", "pbroadcast", "all_gather",
+    "all_to_all", "ppermute", "reduce_scatter", "psum_scatter", "pgather",
+}
+
+# params that (a) are not sub-jaxprs and (b) identify the collective
+_ID_PARAMS = ("axes", "axis_name", "axis_index_groups", "perm",
+              "all_gather_dimension", "scatter_dimension", "split_axis",
+              "concat_axis", "tiled")
+
+
+class CollectiveOrderError(RuntimeError):
+    """A collective schedule that can diverge across ranks."""
+
+
+def _sig(eqn) -> Tuple:
+    params = {k: v for k, v in eqn.params.items() if k in _ID_PARAMS}
+    shapes = tuple(getattr(v.aval, "shape", ()) for v in eqn.invars)
+    return (eqn.primitive.name, tuple(sorted(
+        (k, str(v)) for k, v in params.items())), shapes)
+
+
+def _sub_jaxprs(eqn):
+    """(kind, jaxpr) pairs hiding in an eqn's params (duck-typed: a
+    ClosedJaxpr exposes ``.jaxpr``, a raw Jaxpr exposes ``.eqns``)."""
+    out = []
+    for k, v in eqn.params.items():
+        vals = v if isinstance(v, (tuple, list)) else [v]
+        for item in vals:
+            if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                out.append((k, item.jaxpr))
+            elif hasattr(item, "eqns"):          # raw Jaxpr
+                out.append((k, item))
+    return out
+
+
+def _walk(jaxpr, path: str, schedule: List, violations: List) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _COLLECTIVE_PRIMS:
+            schedule.append((path, _sig(eqn)))
+            continue
+        if name == "cond":
+            # every branch must issue the SAME collective sequence: the
+            # predicate may be rank-divergent, so any difference is a
+            # potential cross-rank deadlock
+            branch_scheds = []
+            for i, (_, sub) in enumerate(_sub_jaxprs(eqn)):
+                s: List = []
+                _walk(sub, f"{path}/cond.branch{i}", s, violations)
+                branch_scheds.append([sig for _, sig in s])
+                schedule.extend(s)
+            if len({tuple(map(repr, b)) for b in branch_scheds}) > 1:
+                violations.append(
+                    f"{path}: lax.cond branches issue different collective "
+                    f"sequences {branch_scheds} — deadlocks if the "
+                    "predicate diverges across ranks")
+            continue
+        if name == "while":
+            body_colls: List = []
+            cond_rank_divergent = False
+            for k, sub in _sub_jaxprs(eqn):
+                s: List = []
+                _walk(sub, f"{path}/while.{k}", s, violations)
+                schedule.extend(s)
+                if k == "cond_jaxpr":
+                    if s:
+                        violations.append(
+                            f"{path}: collective inside a while_loop "
+                            f"predicate ({[sig[0] for _, sig in s]}) — "
+                            "ranks can disagree on the final (failing) "
+                            "evaluation")
+                    if _uses_axis_index(sub):
+                        cond_rank_divergent = True
+                else:
+                    body_colls.extend(s)
+            if cond_rank_divergent and body_colls:
+                violations.append(
+                    f"{path}: while_loop predicate reads axis_index (a "
+                    "rank-divergent trip count) with collectives in the "
+                    f"body ({[sig[0] for _, sig in body_colls]}) — ranks "
+                    "issue different collective counts")
+            continue
+        # transparent containers: pjit, shard_map, scan, remat, custom_*…
+        for _, sub in _sub_jaxprs(eqn):
+            _walk(sub, f"{path}/{name}", schedule, violations)
+
+
+def _uses_axis_index(jaxpr) -> bool:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "axis_index":
+            return True
+        for _, sub in _sub_jaxprs(eqn):
+            if _uses_axis_index(sub):
+                return True
+    return False
+
+
+def collective_schedule(fn, *args, **kwargs):
+    """Trace ``fn`` and return (schedule, violations) without raising.
+
+    schedule: list of (path, (primitive, params, input_shapes)) in program
+    order — identical for every rank on the straight-line path.
+    """
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    schedule: List = []
+    violations: List = []
+    _walk(jaxpr.jaxpr, "", schedule, violations)
+    return schedule, violations
+
+
+def check_collective_order(fn, *args, **kwargs):
+    """Lint ``fn``'s collective schedule; raise CollectiveOrderError on a
+    rank-divergence hazard, else return the schedule."""
+    schedule, violations = collective_schedule(fn, *args, **kwargs)
+    if violations:
+        raise CollectiveOrderError("\n".join(violations))
+    return schedule

@@ -116,9 +116,13 @@ DEFINE("flash_attention_force", False,
 # it in BENCH_OPS.json (round-3 verdict #7)
 DEFINE("flash_attention_block_q", 1024,
        "Pallas flash-attention q block size")
-DEFINE("rms_norm_pallas_min_dim", 32768,
+DEFINE("rms_norm_pallas_min_dim", 1 << 31,
        "route standalone rms_norm rows at least this long to the Pallas "
-       "single-visit kernel; threshold set from v5e measurements "
-       "(ops/norms.py docstring) — below it XLA is as fast or faster")
+       "single-visit kernel.  Default disables the route: the checked-in "
+       "harness (bench.py --op rms_norm, BENCH_OPS.json) measured XLA as "
+       "fast or faster at EVERY shape once tunnel dispatch latency was "
+       "excluded — the earlier 1.73x claim was a measurement artifact.  "
+       "The kernel stays as an opt-in (set a finite threshold) reference "
+       "and Mosaic testbed.")
 DEFINE("flash_attention_block_kv", 1024,
        "Pallas flash-attention kv block size")

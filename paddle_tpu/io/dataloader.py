@@ -225,6 +225,7 @@ class DataLoader:
                     "MMapTokenDataset needs the native io core (no g++?); "
                     "use a map-style Dataset for the pure-Python path")
             rank, world = 0, 1
+            self._native_sampler = None
             if batch_sampler is not None:
                 if not isinstance(batch_sampler, DistributedBatchSampler):
                     raise ValueError(
@@ -234,6 +235,11 @@ class DataLoader:
                 world = batch_sampler.num_replicas
                 shuffle = batch_sampler.shuffle
                 batch_size = batch_sampler.batch_size
+                # the sampler stays the epoch/seed authority: its
+                # set_epoch() keeps working, and its seed wins — same
+                # resume semantics as the pure-Python path
+                seed = batch_sampler.seed
+                self._native_sampler = batch_sampler
             self._native_cfg = {
                 "batch_size": batch_size or 1, "seed": seed,
                 "rank": rank, "world_size": world,
@@ -283,13 +289,16 @@ class DataLoader:
     def _host_batches(self) -> Iterator[Any]:
         if self._native_cfg is not None:
             from .native import NativeTokenLoader
-            loader = NativeTokenLoader(self.dataset, epoch=self._epoch,
+            sampler = self._native_sampler
+            epoch = sampler.epoch if sampler is not None else self._epoch
+            loader = NativeTokenLoader(self.dataset, epoch=epoch,
                                        **self._native_cfg)
             try:
                 yield from loader
             finally:
                 loader.close()
-            self._epoch += 1  # next epoch reshuffles, as the reference does
+            if sampler is None:
+                self._epoch += 1  # next pass reshuffles automatically
             return
         if self._iterable:
             buf = []

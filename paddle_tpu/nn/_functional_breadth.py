@@ -14,6 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..framework import random as _random
@@ -279,7 +280,8 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
 
 
 def soft_margin_loss(input, label, reduction: str = "mean"):
-    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+    # logaddexp(0, z) = log(1 + e^z) without overflow at large z
+    return _reduce(jnp.logaddexp(0.0, -label * input), reduction)
 
 
 def square_error_cost(input, label):
@@ -306,9 +308,13 @@ def triplet_margin_loss(input, positive, negative, margin: float = 1.0,
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training: bool = False, momentum: float = 0.9,
                epsilon: float = 1e-5, data_format: str = "NCHW"):
-    """Functional batch norm. In training mode, batch statistics are used;
-    the *updated running stats are returned as aux* (functional style —
-    jax has no in-place buffers; nn.BatchNorm owns the state threading)."""
+    """Functional batch norm; returns the normalized output only.
+
+    Training mode normalizes by batch statistics; eval mode by the passed
+    running stats.  Running stats are NOT updated here — jax has no
+    in-place buffers, so stat threading (with ``momentum``) belongs to the
+    ``nn.BatchNorm`` layer; ``momentum`` is accepted for signature parity
+    and unused in this functional form."""
     ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else -1
     axes = tuple(i for i in range(x.ndim) if i != ch_axis % x.ndim)
     xf = x.astype(jnp.float32)
@@ -506,6 +512,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0,
                exclusive: bool = True):
     num, win, str_, pad_ = _pool_nd(x, kernel_size, stride, padding, 1,
                                     lax.add, 0.0)
+    if not exclusive:   # paddle: divide by full kernel size incl. padding
+        return num / float(np.prod(win))
     den = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win, str_, pad_)
     return num / den
 
@@ -514,6 +522,8 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0,
                exclusive: bool = True):
     num, win, str_, pad_ = _pool_nd(x, kernel_size, stride, padding, 3,
                                     lax.add, 0.0)
+    if not exclusive:
+        return num / float(np.prod(win))
     den = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, win, str_, pad_)
     return num / den
 

@@ -648,8 +648,11 @@ def renorm(x, p: float, axis: int, max_norm: float):
 
 def sgn(x):
     if jnp.iscomplexobj(x):
+        # x * 0 builds the complex zero from an ARRAY — an eager Python
+        # complex-scalar constant poisons the tunnel chip's backend
+        # (tensor/fft.py chip notes)
         mag = jnp.abs(x)
-        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1.0, mag))
+        return jnp.where(mag == 0, x * 0, x / jnp.where(mag == 0, 1.0, mag))
     return jnp.sign(x)
 
 

@@ -262,6 +262,10 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
     x: (N, A*(5+C), H, W); returns (boxes (N, A*H*W, 4), scores (N, A*H*W, C)).
     """
+    if iou_aware:
+        raise NotImplementedError(
+            "iou_aware yolo_box (extra per-anchor IoU channel blended into "
+            "conf) is not implemented — registry work queue")
     n, _, h, w = x.shape
     na = len(anchors) // 2
     an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)

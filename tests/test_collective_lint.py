@@ -1,0 +1,170 @@
+"""Collective-order lint (SURVEY §5 sanitizers row, round-3 verdict #10).
+
+jax's vma type system already rejects cond branches whose collective SETS
+differ (output types diverge); the lint's residual value is (a) ordering —
+branches with the same collectives in a different order type-check but
+deadlock if the predicate diverges across ranks — (b) collectives inside
+while-loop predicates, and (c) the extracted schedule itself, pinnable in
+tests so comm-order regressions show as a diff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed import lint
+
+
+def _mesh4():
+    return Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+
+
+_PERM = [(i, (i + 1) % 4) for i in range(4)]
+
+
+def test_schedule_extraction_through_shard_map_and_scan():
+    mesh = _mesh4()
+
+    def fn(x):
+        def inner(x):
+            def step(c, _):
+                # ppermute is vma-type-preserving, so it can live in a
+                # scan carry; psum follows outside
+                return jax.lax.ppermute(c, "dp", _PERM), None
+            c, _ = jax.lax.scan(step, x, None, length=3)
+            return jax.lax.psum(c, "dp")
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+
+    sched = lint.check_collective_order(fn, jnp.ones((8, 4)))
+    prims = [sig[0] for _, sig in sched]
+    assert prims == ["ppermute", "psum_invariant"]
+    assert "/shard_map/scan" in sched[0][0]          # path says where
+
+
+def test_cond_with_mismatched_perms_is_flagged():
+    """Branches whose vma TYPES match (jax's checker accepts) but whose
+    communication differs — here opposite ppermute rings, the shape of a
+    pipeline send-forward vs send-backward hidden in a cond.  If the
+    predicate diverges across ranks, sender and receiver disagree; only
+    the lint sees it."""
+    mesh = _mesh4()
+    rev = [(i, (i - 1) % 4) for i in range(4)]
+
+    def fn(x):
+        def inner(x):
+            def a(v):
+                return jax.lax.ppermute(v, "dp", _PERM)
+
+            def b(v):
+                return jax.lax.ppermute(v, "dp", rev)
+            return jax.lax.cond(x[0, 0] > 0, a, b, x)
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+
+    with pytest.raises(lint.CollectiveOrderError, match="different"):
+        lint.check_collective_order(fn, jnp.ones((8, 4)))
+
+
+def test_cond_with_identical_sequences_passes():
+    mesh = _mesh4()
+
+    def fn(x):
+        def inner(x):
+            def a(v):
+                return jax.lax.psum(v * 2.0, "dp")
+
+            def b(v):
+                return jax.lax.psum(v + 1.0, "dp")
+            return jax.lax.cond(x[0, 0] > 0, a, b, x)
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+
+    sched = lint.check_collective_order(fn, jnp.ones((8, 4)))
+    assert [sig[0] for _, sig in sched].count("psum_invariant") == 2
+
+
+def test_collective_in_while_predicate_is_flagged():
+    mesh = _mesh4()
+
+    def fn(x):
+        def inner(x):
+            def cond(c):
+                return jax.lax.psum(jnp.sum(c), "dp") < 100.0
+
+            def body(c):
+                return c + 1.0
+            return jax.lax.while_loop(cond, body, x)
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+
+    with pytest.raises(lint.CollectiveOrderError, match="predicate"):
+        lint.check_collective_order(fn, jnp.ones((8, 4)))
+
+
+def test_real_train_step_lints_clean(mesh8):
+    """The framework's own hybrid train step must pass its own sanitizer
+    (and the schedule is non-empty: vocab-parallel loss + grad reductions
+    issue real collectives)."""
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    from paddle_tpu.optimizer import AdamW
+
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, sharding_degree=2,
+                                      mp_degree=2)
+    dist.set_hybrid_group(hcg)
+    try:
+        pt.seed(0)
+        model = LlamaForCausalLM(tiny_llama_config())
+        step, params, opt_state = dist.build_train_step(
+            model, AdamW(learning_rate=1e-3), hcg=hcg, zero_stage=1)
+        ids = jnp.zeros((4, 16), jnp.int32)
+        batch = {"input_ids": ids, "labels": ids}
+        sched = lint.check_collective_order(
+            step, params, opt_state, batch, jax.random.key(0))
+        assert sched, "train step issued no collectives?"
+    finally:
+        dist.set_hybrid_group(None)
+
+
+def test_rank_divergent_while_body_collective_is_flagged():
+    """axis_index-derived trip count + collective in the body: ranks run
+    the collective a different number of times.  A rank-uniform predicate
+    with the same body passes."""
+    mesh = _mesh4()
+
+    def divergent(x):
+        def inner(x):
+            def cond(c):
+                i, v = c
+                return i < jax.lax.axis_index("dp") + 1
+
+            def body(c):
+                i, v = c
+                return i + 1, jax.lax.ppermute(v, "dp", _PERM)
+            return jax.lax.while_loop(cond, body, (0, x))[1]
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+
+    with pytest.raises(lint.CollectiveOrderError, match="axis_index"):
+        lint.check_collective_order(divergent, jnp.ones((8, 4)))
+
+    def uniform(x):
+        def inner(x):
+            def cond(c):
+                i, v = c
+                return i < 3
+
+            def body(c):
+                i, v = c
+                return i + 1, jax.lax.ppermute(v, "dp", _PERM)
+            return jax.lax.while_loop(cond, body, (0, x))[1]
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+
+    sched = lint.check_collective_order(uniform, jnp.ones((8, 4)))
+    assert [sig[0] for _, sig in sched] == ["ppermute"]

@@ -194,13 +194,21 @@ def test_dataloader_native_with_distributed_sampler(token_bin):
     ds = native.MMapTokenDataset(path, seq_len=33, stride=33)
     shards = []
     for rank in range(2):
+        # the sampler is the seed/epoch authority (reference parity):
+        # its seed wins over DataLoader's, its set_epoch drives reshuffle
         bs = DistributedBatchSampler(ds, batch_size=4, num_replicas=2,
-                                     rank=rank, shuffle=True)
-        dl = DataLoader(ds, batch_sampler=bs, num_workers=1, seed=5)
+                                     rank=rank, shuffle=True, seed=5)
+        dl = DataLoader(ds, batch_sampler=bs, num_workers=1)
         shards.append(list(dl))
         want = oracle_batches(toks, 33, 33, batch=4, seed=5, epoch=0,
                               rank=rank, world=2)
         for g, w in zip(shards[-1], want):
+            np.testing.assert_array_equal(g, w)
+        bs.set_epoch(3)
+        got3 = list(dl)
+        want3 = oracle_batches(toks, 33, 33, batch=4, seed=5, epoch=3,
+                               rank=rank, world=2)
+        for g, w in zip(got3, want3):
             np.testing.assert_array_equal(g, w)
     seen0 = {tuple(row) for b in shards[0] for row in b}
     seen1 = {tuple(row) for b in shards[1] for row in b}
