@@ -132,10 +132,9 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "rrelu", "selu", "sequence_mask", "sigmoid_focal_loss",
         "soft_margin_loss", "softshrink", "softsign", "square_error_cost",
         "tanhshrink", "thresholded_relu", "triplet_margin_loss", "upsample",
-        "zeropad2d",
-        # work queue (absent): dynamic-alignment / specialised losses
-        "ctc_loss", "margin_cross_entropy", "class_center_sample",
-        "temporal_shift",
+        "zeropad2d", "ctc_loss", "margin_cross_entropy", "temporal_shift",
+        # work queue (absent)
+        "class_center_sample",
     ],
     "paddle.incubate": [
         # fused / long-context ops (upstream: paddle.incubate.nn.functional
@@ -166,10 +165,9 @@ TARGET_SURFACE: Dict[str, List[str]] = {
     "paddle.signal": ["stft", "istft"],
     "paddle.vision.ops": [
         "box_coder", "nms", "prior_box", "roi_align", "roi_pool",
-        "yolo_box",
-        # work queue (absent): remaining detection kernels
-        "deform_conv2d", "distribute_fpn_proposals", "generate_proposals",
-        "matrix_nms", "psroi_pool", "yolo_loss",
+        "yolo_box", "deform_conv2d", "matrix_nms", "psroi_pool",
+        # work queue (absent): proposal-generation stages
+        "distribute_fpn_proposals", "generate_proposals", "yolo_loss",
     ],
     "paddle.sparse": [
         "sparse_coo_tensor", "sparse_csr_tensor", "coalesce",
@@ -177,27 +175,25 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "add", "subtract", "multiply", "divide", "sin", "tan", "asin",
         "atan", "sinh", "tanh", "asinh", "atanh", "sqrt", "square",
         "log1p", "abs", "expm1", "pow", "cast", "neg", "rad2deg",
-        "deg2rad",
-        # work queue (absent): pattern-captured kernels (cuSPARSE SDDMM /
-        # submanifold conv equivalents — Pallas targets)
-        "masked_matmul", "mask_as", "slice", "sum",
+        "deg2rad", "sum", "slice", "mask_as", "masked_matmul",
     ],
     "paddle.sparse.nn": [
-        "relu", "relu6", "leaky_relu",
-        # work queue (absent)
-        "softmax", "attention", "conv3d", "subm_conv3d",
+        "relu", "relu6", "leaky_relu", "softmax",
+        # work queue (absent): gather-scatter Pallas kernels
+        "attention", "conv3d", "subm_conv3d",
     ],
     "paddle.Tensor": [
         # method surface of the Tensor facade (tensor_facade.py): resolved
         # by attribute lookup on a live instance, so jax.Array fallthrough
         # methods count as implemented only if they actually resolve.
         "astype", "clone", "cpu", "detach", "dim", "element_size", "item",
-        "ndimension", "numel", "numpy", "to", "tolist",
+        "ndimension", "numel", "numpy", "to", "tolist", "to_dense",
+        "to_sparse_coo", "value_counts",
         # dispatch-by-name methods (one per tensor-module function) are
         # covered by the function categories; these are the extra
-        # method-only names still absent:
-        "backward", "register_hook", "to_dense", "to_sparse_coo",
-        "value_counts", "pin_memory",
+        # method-only names still absent (tape/pinned-host semantics that
+        # have no functional-jax equivalent yet):
+        "backward", "register_hook", "pin_memory",
     ],
 }
 

@@ -441,6 +441,22 @@ def _round4_cases(I):
         "dropout3d": lambda f: f(vol),
         "label_smooth": lambda f: f(unit),
         "sequence_mask": lambda f: f(jnp.asarray([1, 2]), 3),
+        "temporal_shift": lambda f: f(jnp.ones((2, 4, 4, 4)), 2),
+        "margin_cross_entropy": lambda f: f(
+            unit[:, :3] * 2.0 - 1.0, jnp.asarray([0, 2])),
+        "ctc_loss": lambda f: f(
+            jax.nn.log_softmax(jnp.ones((6, 2, 5)), axis=-1),
+            jnp.asarray([[1, 2, 3], [2, 4, 0]]),
+            jnp.asarray([6, 5]), jnp.asarray([3, 2])),
+        "matrix_nms": lambda f: f(
+            jnp.asarray([[[0.0, 0, 4, 4], [1.0, 1, 5, 5],
+                          [8.0, 8, 9, 9]]]),
+            jnp.asarray([[[0.0, 0.0, 0.0], [0.9, 0.8, 0.7]]]), 0.1),
+        "psroi_pool": lambda f: f(
+            jnp.ones((1, 8, 8, 8)), boxes, [2], 2, 1.0, 2, 2),
+        "deform_conv2d": lambda f: f(
+            jnp.ones((1, 2, 5, 5)), jnp.zeros((1, 2 * 4, 4, 4)),
+            jnp.ones((2, 2, 2, 2)) * 0.1),
         # -- sparse (qualified: names collide with dense namespaces)
         "paddle.sparse:sparse_coo_tensor": lambda f: f(
             jnp.asarray([[0, 1], [1, 2]]), jnp.asarray([1.0, 2.0]), (2, 3)),
@@ -461,6 +477,12 @@ def _round4_cases(I):
         "paddle.sparse:divide": lambda f: f(_coo(), _coo()),
         "paddle.sparse:pow": lambda f: f(_coo(), 2.0),
         "paddle.sparse:cast": lambda f: f(_coo(), None, jnp.float32),
+        "paddle.sparse:sum": lambda f: f(_coo(), axis=1),
+        "paddle.sparse:slice": lambda f: f(_coo(), [0, 1], [0, 0], [2, 2]),
+        "paddle.sparse:mask_as": lambda f: f(jnp.ones((2, 3)), _coo()),
+        "paddle.sparse:masked_matmul": lambda f: f(
+            jnp.ones((2, 3)), jnp.ones((3, 3)), _coo()),
+        "paddle.sparse.nn:softmax": lambda f: f(_coo()),
     }
     for name in ("sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
                  "atanh", "sqrt", "square", "log1p", "abs", "expm1", "neg",
@@ -521,6 +543,9 @@ def _tensor_method_thunk(name: str):
             "numpy": lambda: t.numpy(),
             "to": lambda: t.to("float32"),
             "tolist": lambda: t.tolist(),
+            "value_counts": lambda: t.value_counts(),
+            "to_dense": lambda: t.to_dense(),
+            "to_sparse_coo": lambda: t.to_sparse_coo(),
         }
         if name not in calls:
             raise RuntimeError(f"paddle.Tensor:{name} has no smoke case")

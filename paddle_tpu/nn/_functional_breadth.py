@@ -42,6 +42,8 @@ __all__ = [
     "affine_grid", "grid_sample", "pixel_shuffle", "pixel_unshuffle",
     "channel_shuffle", "fold", "upsample", "zeropad2d", "alpha_dropout",
     "dropout2d", "dropout3d", "label_smooth", "sequence_mask",
+    # round-4 queue shrink
+    "temporal_shift", "margin_cross_entropy", "ctc_loss",
 ]
 
 
@@ -774,3 +776,117 @@ def sequence_mask(lengths, maxlen=None, dtype="bool"):
     mask = jnp.arange(maxlen)[None, :] < jnp.asarray(lengths)[..., None]
     from ..framework.dtype import to_jax_dtype
     return mask.astype(to_jax_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# round-4 queue shrink: video / metric-learning / alignment losses
+# ---------------------------------------------------------------------------
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW"):
+    """TSM temporal shift (parity: F.temporal_shift): within each clip of
+    ``seg_num`` frames, the first ``shift_ratio`` of channels shift one
+    frame back, the next ``shift_ratio`` shift one frame forward, the rest
+    stay.  x: (N*T, C, H, W)."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    t = seg_num
+    n = nt // t
+    fold = int(c * shift_ratio)
+    v = x.reshape(n, t, c, h, w)
+    back = jnp.pad(v[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                     (0, 0)))           # frame t+1 → t
+    fwd = jnp.pad(v[:, :-1, fold:2 * fold], ((0, 0), (1, 0), (0, 0),
+                                             (0, 0), (0, 0)))
+    out = jnp.concatenate([back, fwd, v[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    return jnp.moveaxis(out, 1, -1) if data_format == "NHWC" else out
+
+
+def margin_cross_entropy(logits, label, margin1: float = 1.0,
+                         margin2: float = 0.5, margin3: float = 0.0,
+                         scale: float = 64.0, return_softmax: bool = False,
+                         reduction: str = "mean"):
+    """ArcFace-family margin softmax (parity: F.margin_cross_entropy,
+    single-group form — the reference's model-parallel variant maps to the
+    vocab-parallel CE machinery in fleet/mp_layers).  logits are cosines;
+    the target class angle is transformed cos(m1·θ + m2) − m3 before the
+    scaled softmax."""
+    cos = jnp.clip(logits.astype(jnp.float32), -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=jnp.float32)
+    adjusted = scale * jnp.where(onehot > 0, target, cos)
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank: int = 0, reduction: str = "mean",
+             norm_by_times: bool = False):
+    """CTC loss (parity: F.ctc_loss; upstream wraps warpctc).
+
+    Forward (alpha) recursion in the log semiring over the blank-extended
+    label sequence, as one ``lax.scan`` over time — the XLA-native shape
+    of warpctc's per-(t, s) dynamic program.  ``log_probs``: (T, N, C)
+    UNSCALED logits, normalised internally like warpctc (paddle's calling
+    convention; log_softmax is idempotent, so pre-normalised inputs also
+    work); labels: (N, L) int padded.
+    """
+    log_probs = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    T, N, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    NEG = jnp.float32(-1e30)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    # can we skip from s-2 to s? only if ext[s] != blank and != ext[s-2]
+    skip_ok = jnp.pad(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]),
+        ((0, 0), (2, 0)), constant_values=False)
+
+    def emit(t_lp):
+        return jnp.take_along_axis(t_lp, ext, axis=1)       # (N, S)
+
+    alpha0 = jnp.full((N, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(log_probs[0])[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0, emit(log_probs[0])[:, 1], NEG))
+
+    def step(alpha, t_lp):
+        stay = alpha
+        prev1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                        constant_values=NEG)
+        prev2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                        constant_values=NEG)
+        prev2 = jnp.where(skip_ok, prev2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        return merged + emit(t_lp), None
+
+    def masked_step(carry, inp):
+        alpha, t = carry
+        t_lp = inp
+        new, _ = step(alpha, t_lp)
+        # past a row's input length the alphas freeze
+        alive = (t < input_lengths)[:, None]
+        return (jnp.where(alive, new, alpha), t + 1), None
+
+    (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.int32(1)),
+                                 log_probs[1:])
+    # total prob: last blank + last label state (per row's label length)
+    sl = 2 * label_lengths.astype(jnp.int32)                # (N,)
+    a_last = jnp.take_along_axis(alpha, sl[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(sl - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_lengths > 0, a_prev, NEG)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    return _reduce(loss, reduction)

@@ -9,10 +9,12 @@ gather+segment-sum HLOs that XLA tiles onto the MXU's neighbouring vector
 units. Zero-preserving unary math acts on ``.data`` directly (free);
 sparse-sparse elementwise ops ride BCOO's sum-duplicates machinery.
 
-Absent (visible in the registry's work queue): masked_matmul, sparse
-softmax/attention, sparse conv3d — these need a captured sparsity-pattern
-kernel (cuSPARSE SDDMM equivalents) that we'd build in Pallas when a model
-config demands them.
+Pattern-captured kernels (round-4 queue shrink): ``masked_matmul`` is the
+SDDMM — gather rows/cols by the mask's indices and contract, O(nse·K),
+never materialising the dense product; ``nn.softmax`` runs per-row over
+stored values via segment max/sum.  Still absent (registry work queue):
+sparse attention and (subm_)conv3d — those need gather-scatter Pallas
+kernels with halo exchange when a model config demands them.
 """
 
 from __future__ import annotations
@@ -30,6 +32,7 @@ __all__ = [
     "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
     "sqrt", "square", "log1p", "abs", "expm1", "pow", "cast", "neg",
     "rad2deg", "deg2rad",
+    "sum", "slice", "mask_as", "masked_matmul",
 ]
 
 
@@ -149,3 +152,66 @@ def cast(x, index_dtype=None, value_dtype=None):
     data = x.data.astype(value_dtype) if value_dtype else x.data
     idx = x.indices.astype(index_dtype) if index_dtype else x.indices
     return jsparse.BCOO((data, idx), shape=x.shape)
+
+
+# -- round-4 queue shrink ----------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim: bool = False):
+    """paddle.sparse.sum: full reduction → dense scalar; axis reduction →
+    sparse result (bcoo_reduce_sum keeps the sparse encoding)."""
+    x = _as_bcoo(x)
+    if axis is None:
+        out = jnp.sum(x.data, dtype=dtype)
+        return jnp.reshape(out, (1,) * x.ndim) if keepdim else out
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a % x.ndim for a in axes)
+    out = jsparse.bcoo_reduce_sum(x, axes=axes)
+    if dtype is not None:
+        out = jsparse.BCOO((out.data.astype(dtype), out.indices),
+                           shape=out.shape)
+    if keepdim:
+        kept = [1 if a in axes else s for a, s in enumerate(x.shape)]
+        out = jsparse.bcoo_reshape(out, new_sizes=tuple(kept))
+    return out
+
+
+def slice(x, axes, starts, ends):
+    """paddle.sparse.slice: static-bound slicing via bcoo_dynamic_slice."""
+    x = _as_bcoo(x)
+    start = [0] * x.ndim
+    size = list(x.shape)
+    for ax, s, e in zip(axes, starts, ends):
+        ax = ax % x.ndim
+        s = s % x.shape[ax] if s < 0 else min(s, x.shape[ax])
+        e = e % x.shape[ax] if e < 0 else min(e, x.shape[ax])
+        start[ax] = s
+        size[ax] = e - s
+    return jsparse.bcoo_dynamic_slice(x, start, size)
+
+
+def mask_as(x, mask):
+    """Project dense ``x`` onto sparse ``mask``'s pattern (paddle's
+    mask_as / sparse_mask): values gathered at the mask's coordinates,
+    keeping ``x``'s dtype."""
+    mask = _as_bcoo(mask)
+    coords = tuple(mask.indices[:, d] for d in range(mask.ndim))
+    data = jnp.asarray(x)[coords]
+    return jsparse.BCOO((data, mask.indices), shape=mask.shape,
+                        indices_sorted=mask.indices_sorted,
+                        unique_indices=mask.unique_indices)
+
+
+def masked_matmul(x, y, mask):
+    """SDDMM (parity: paddle.sparse.masked_matmul — cuSPARSE's sampled
+    dense-dense matmul): compute (x @ y) only at ``mask``'s nonzero
+    coordinates.  TPU shape: gather the needed rows of x and columns of y
+    by the mask's indices and contract — O(nse · K) FLOPs and memory,
+    never materialising the dense product."""
+    mask = _as_bcoo(mask)
+    rows = mask.indices[:, 0]
+    cols = mask.indices[:, 1]
+    data = jnp.einsum("nk,nk->n", jnp.asarray(x)[rows],
+                      jnp.asarray(y).T[cols])
+    return jsparse.BCOO((data, mask.indices), shape=mask.shape,
+                        indices_sorted=mask.indices_sorted,
+                        unique_indices=mask.unique_indices)

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
-__all__ = ["relu", "relu6", "leaky_relu"]
+__all__ = ["relu", "relu6", "leaky_relu", "softmax"]
 
 
 def _unary(fn):
@@ -25,3 +25,25 @@ relu6 = _unary(jax.nn.relu6)
 
 def leaky_relu(x, negative_slope: float = 0.01):
     return _unary(lambda d: jax.nn.leaky_relu(d, negative_slope))(x)
+
+
+def softmax(x, axis: int = -1):
+    """Sparse softmax over the nonzeros of each row (parity:
+    paddle.sparse.nn.functional.softmax, 2-D): zeros stay structural
+    zeros; normalisation runs per-row over stored values only, via
+    segment max/sum keyed by the row index."""
+    if isinstance(x, jsparse.BCSR):
+        x = x.to_bcoo()
+    if x.ndim != 2 or axis not in (-1, 1):
+        raise NotImplementedError("sparse softmax: 2-D, last axis only")
+    rows = x.indices[:, 0]
+    n = x.shape[0]
+    import jax.ops  # noqa: F401  (segment ops live under jax.ops)
+    row_max = jax.ops.segment_max(x.data, rows, num_segments=n,
+                                  indices_are_sorted=x.indices_sorted)
+    shifted = jnp.exp(x.data - row_max[rows])
+    row_sum = jax.ops.segment_sum(shifted, rows, num_segments=n,
+                                  indices_are_sorted=x.indices_sorted)
+    return jsparse.BCOO((shifted / row_sum[rows], x.indices),
+                        shape=x.shape, indices_sorted=x.indices_sorted,
+                        unique_indices=x.unique_indices)

@@ -23,6 +23,8 @@ convenience, not a parallel type system.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -92,6 +94,37 @@ class Tensor:
     def cpu(self):
         return Tensor(jax.device_put(
             self.value, jax.devices("cpu")[0]))
+
+    def value_counts(self, sort: bool = True, ascending: bool = False):
+        """(unique values, counts) — host-eager, like paddle's dynamic-
+        shape op on XLA."""
+        import numpy as np
+        vals, counts = np.unique(np.asarray(self.value), return_counts=True)
+        if sort:
+            order = np.argsort(counts if ascending else -counts,
+                               kind="stable")
+            vals, counts = vals[order], counts[order]
+        return Tensor(vals), Tensor(counts)
+
+    def to_dense(self):
+        from jax.experimental import sparse as jsparse
+        if isinstance(self.value, (jsparse.BCOO, jsparse.BCSR)):
+            return Tensor(self.value.todense())
+        return Tensor(self.value)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None):
+        """Dense → sparse COO (host-eager: nse is data-dependent).
+        ``sparse_dim`` < ndim gives paddle's hybrid layout: leading dims
+        sparse, trailing dims dense (BCOO n_dense)."""
+        import numpy as np
+
+        from jax.experimental import sparse as jsparse
+        arr = np.asarray(self.value)
+        n_dense = 0 if sparse_dim is None else arr.ndim - sparse_dim
+        if n_dense < 0 or (sparse_dim is not None and sparse_dim < 1):
+            raise ValueError(f"sparse_dim must be in [1, {arr.ndim}], "
+                             f"got {sparse_dim}")
+        return jsparse.BCOO.fromdense(jnp.asarray(arr), n_dense=n_dense)
 
     def to(self, *args, **kwargs):
         """paddle.Tensor.to(dtype) / .to(device): dtype strings cast;

@@ -11,8 +11,8 @@ boxes and mask on the returned keep/score arrays, the standard TPU
 detection recipe.
 
 Not yet implemented (visible in the op registry's absent list):
-deform_conv2d, distribute_fpn_proposals, generate_proposals, psroi_pool,
-yolo_loss, matrix_nms — see framework/op_registry.py.
+distribute_fpn_proposals, generate_proposals, yolo_loss — see
+framework/op_registry.py.
 """
 
 from __future__ import annotations
@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "prior_box",
-           "yolo_box"]
+           "yolo_box", "matrix_nms", "psroi_pool", "deform_conv2d"]
 
 
 def _iou_matrix(boxes):
@@ -301,3 +301,208 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     boxes = boxes.reshape(n, na * h * w, 4)
     scores = jnp.moveaxis(scores, 2, -1).reshape(n, na * h * w, class_num)
     return boxes, scores
+
+
+# -- round-4 queue shrink -----------------------------------------------------
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k: int = 400, keep_top_k: int = 200,
+               use_gaussian: bool = False, gaussian_sigma: float = 2.0,
+               background_label: int = 0, normalized: bool = True,
+               return_index: bool = False, return_rois_num: bool = True):
+    """Matrix NMS (SOLOv2): fully-parallel soft suppression — no greedy
+    loop.  For each candidate the decay is min over higher-scored
+    same-class boxes j of f(iou_ij)/f(iou_max_j); scores decay instead of
+    boxes dying, then a single threshold keeps survivors.  This is the
+    one NMS variant whose reference CUDA kernel is already matrix-shaped,
+    so the TPU expression is the natural one.
+
+    bboxes: (N, M, 4); scores: (N, C, M).  Returns (out (K, 6)
+    [label, score, x1, y1, x2, y2], [index], rois_num) with host-side
+    selection (data-dependent K, like the reference's dynamic output).
+    """
+    import numpy as np
+
+    def np_iou(bx):
+        area = (np.maximum(bx[:, 2] - bx[:, 0], 0)
+                * np.maximum(bx[:, 3] - bx[:, 1], 0))
+        lt = np.maximum(bx[:, None, :2], bx[None, :, :2])
+        rb = np.minimum(bx[:, None, 2:], bx[None, :, 2:])
+        wh = np.maximum(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        union = area[:, None] + area[None, :] - inter
+        return np.where(union > 0, inter / union, 0.0)
+
+    outs, idxs, nums = [], [], []
+    bboxes_np = np.asarray(bboxes)     # one device sync; loops stay host-side
+    scores_np = np.asarray(scores)
+    n, c, m = scores_np.shape
+    for b in range(n):
+        cand = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = scores_np[b, cls]
+            keep = np.nonzero(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            iou = np_iou(bboxes_np[b][order])
+            s = sc[order]
+            k = len(order)
+            upper = np.triu(iou, 1)      # upper[i, j]: iou, i higher-scored
+            iou_max = upper.max(axis=0)  # box i's max iou w/ its suppressors
+            # decay[i, j] = f(iou_ij) / f(iou_max_i): suppressor i's own
+            # suppression compensates the denominator (SOLOv2 eq. 5)
+            if use_gaussian:
+                decay = np.exp(-(upper ** 2 - iou_max[:, None] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1.0 - upper) / np.maximum(1.0 - iou_max[:, None],
+                                                   1e-10)
+            decay = np.where(np.triu(np.ones((k, k), bool), 1), decay, 1.0)
+            decayed = s * decay.min(axis=0)
+            for i in range(k):
+                if decayed[i] > post_threshold:
+                    cand.append((cls, decayed[i], order[i]))
+        cand.sort(key=lambda t: -t[1])
+        cand = cand[:keep_top_k]
+        rows = np.asarray(
+            [[cls, s, *bboxes_np[b][i]] for cls, s, i in cand],
+            np.float32).reshape(-1, 6)
+        outs.append(rows)
+        idxs.extend(b * m + i for _, _, i in cand)
+        nums.append(len(cand))
+    out = jnp.asarray(np.concatenate(outs, axis=0) if outs
+                      else np.zeros((0, 6), np.float32))
+    res = [out]
+    if return_index:
+        res.append(jnp.asarray(np.asarray(idxs, np.int64).reshape(-1, 1)))
+    if return_rois_num:
+        res.append(jnp.asarray(np.asarray(nums, np.int32)))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def psroi_pool(x, boxes, boxes_num, output_channels: int,
+               spatial_scale: float = 1.0, pooled_height: int = 1,
+               pooled_width: int = 1):
+    """Position-sensitive RoI pooling (R-FCN): output channel c at bin
+    (i, j) AVERAGE-pools input channel c·ph·pw + i·pw + j over the bin —
+    same masked-reduction formulation as roi_pool, with the channel
+    gather expressed as one reshape."""
+    import numpy as np
+
+    ph, pw = pooled_height, pooled_width
+    n, cin, h, w = x.shape
+    if cin != output_channels * ph * pw:
+        raise ValueError(f"psroi_pool: in_channels {cin} != "
+                         f"output_channels*ph*pw {output_channels*ph*pw}")
+    counts = np.asarray(boxes_num)
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+
+    bx = boxes * spatial_scale
+    x1, y1 = jnp.round(bx[:, 0]), jnp.round(bx[:, 1])
+    x2 = jnp.maximum(jnp.round(bx[:, 2]), x1 + 1)
+    y2 = jnp.maximum(jnp.round(bx[:, 3]), y1 + 1)
+    bin_h = (y2 - y1) / ph
+    bin_w = (x2 - x1) / pw
+    # (R, C, ph, pw, H, W) masked mean, with C mapped per (i, j)
+    feat = x.reshape(n, output_channels, ph, pw, h, w)
+
+    def pool_one(img, bx1, by1, bw, bh):
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        i = jnp.arange(ph, dtype=jnp.float32)
+        j = jnp.arange(pw, dtype=jnp.float32)
+        y_lo = jnp.floor(by1 + i * bh)[:, None]
+        y_hi = jnp.ceil(by1 + (i + 1) * bh)[:, None]
+        x_lo = jnp.floor(bx1 + j * bw)[:, None]
+        x_hi = jnp.ceil(bx1 + (j + 1) * bw)[:, None]
+        ymask = (ys >= y_lo) & (ys < y_hi)               # (ph, H)
+        xmask = (xs >= x_lo) & (xs < x_hi)               # (pw, W)
+        mask = (ymask[:, None, :, None]
+                & xmask[None, :, None, :]).astype(jnp.float32)
+        # img: (C, ph, pw, H, W) — bin (i,j) pools its own channel slice
+        num = jnp.einsum("cijhw,ijhw->cij", img, mask)
+        den = jnp.maximum(mask.sum(axis=(-1, -2)), 1.0)
+        return num / den[None]
+
+    return jax.vmap(pool_one)(feat[batch_idx], x1, y1, bin_w, bin_h)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups: int = 1, groups: int = 1,
+                  mask=None):
+    """Deformable convolution v1/v2 (parity: paddle.vision.ops.
+    deform_conv2d; reference kernel paddle/phi/kernels/gpu/
+    deformable_conv_kernel.cu).
+
+    TPU formulation: per kernel tap k the sampling locations are the
+    regular grid + the learned offsets; sampling is one batched bilinear
+    gather (grid_sample's math), giving (N, Cin, K, Ho, Wo) columns that a
+    single einsum contracts with the weights — im2col with learned
+    coordinates, MXU-friendly, no per-pixel loop.
+
+    x: (N, Cin, H, W); offset: (N, 2·dg·kh·kw, Ho, Wo) ordered (y, x) per
+    tap; mask (v2): (N, dg·kh·kw, Ho, Wo); weight: (Cout, Cin/groups, kh,
+    kw).
+    """
+    n, cin, h, w = x.shape
+    cout, cpg, kh, kw = weight.shape
+    k = kh * kw
+    dg = deformable_groups
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p_h, p_w = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    ho = (h + 2 * p_h - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (w + 2 * p_w - (dw * (kw - 1) + 1)) // sw + 1
+
+    # base sampling grid per tap: (K, Ho, Wo)
+    oy = jnp.arange(ho) * sh - p_h
+    ox = jnp.arange(wo) * sw - p_w
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                          indexing="ij")
+    base_y = ky.reshape(k, 1, 1) + oy[None, :, None]
+    base_x = kx.reshape(k, 1, 1) + ox[None, None, :]
+
+    off = offset.reshape(n, dg, k, 2, ho, wo)
+    sy = base_y[None, None] + off[:, :, :, 0]            # (N, dg, K, Ho, Wo)
+    sx = base_x[None, None] + off[:, :, :, 1]
+
+    def sample_chan_group(img, gy, gx):
+        """img: (C', H, W); gy/gx: (K, Ho, Wo) → (C', K, Ho, Wo)."""
+        y0 = jnp.floor(gy)
+        x0 = jnp.floor(gx)
+        wy = gy - y0
+        wx = gx - x0
+        out = 0.0
+        for ddy, ddx, wgt in [(0, 0, (1 - wy) * (1 - wx)),
+                              (0, 1, (1 - wy) * wx),
+                              (1, 0, wy * (1 - wx)),
+                              (1, 1, wy * wx)]:
+            yi = (y0 + ddy).astype(jnp.int32)
+            xi = (x0 + ddx).astype(jnp.int32)
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            vals = img[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+            out = out + jnp.where(valid[None], vals * wgt[None], 0.0)
+        return out
+
+    # split channels over deformable groups, sample, stack back
+    xg = x.reshape(n, dg, cin // dg, h, w)
+    cols = jax.vmap(jax.vmap(sample_chan_group))(
+        xg, sy, sx)                                     # (N, dg, C/dg, K, Ho, Wo)
+    cols = cols.reshape(n, cin, k, ho, wo)
+    if mask is not None:
+        m = mask.reshape(n, dg, 1, k, ho, wo)
+        cols = (cols.reshape(n, dg, cin // dg, k, ho, wo) * m
+                ).reshape(n, cin, k, ho, wo)
+
+    wmat = weight.reshape(groups, cout // groups, cpg, k)
+    colsg = cols.reshape(n, groups, cpg, k, ho, wo)
+    out = jnp.einsum("ngckhw,gock->ngohw", colsg, wmat)
+    out = out.reshape(n, cout, ho, wo)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

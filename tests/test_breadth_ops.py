@@ -555,3 +555,189 @@ def test_tensor_facade_round4_methods():
     assert t.astype("int32").dtype == jnp.int32
     assert t.to("float32").dtype == jnp.float32
     assert t.cpu().value.devices() == {jax.devices("cpu")[0]}
+
+
+# ---------------------------------------------------------------------------
+# round-4 queue shrink: ctc/margin/temporal_shift, sparse SDDMM family,
+# deform_conv2d / psroi_pool / matrix_nms, Tensor sparse bridges
+# ---------------------------------------------------------------------------
+
+def test_ctc_loss_against_torch():
+    # paddle's convention: ctc_loss takes UNSCALED logits and normalises
+    # internally (warpctc); torch's takes log-probs — feed each its own
+    T, N, C, Lm = 12, 3, 6, 4
+    logits = rs.randn(T, N, C).astype(np.float32)
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1)
+    labels = torch.tensor(rs.randint(1, C, (N, Lm)))
+    ilen = torch.tensor([12, 10, 8])
+    llen = torch.tensor([4, 3, 2])
+    ref = torch.nn.functional.ctc_loss(lp, labels, ilen, llen, blank=0,
+                                       reduction="none")
+    ours = F.ctc_loss(jnp.asarray(logits), jnp.asarray(labels.numpy()),
+                      jnp.asarray(ilen.numpy()), jnp.asarray(llen.numpy()),
+                      reduction="none")
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    # repeated labels exercise the no-skip rule
+    rep = torch.tensor([[2, 2, 3, 3]] * N)
+    ref2 = torch.nn.functional.ctc_loss(lp, rep, ilen,
+                                        torch.tensor([4, 4, 4]),
+                                        blank=0, reduction="none")
+    ours2 = F.ctc_loss(jnp.asarray(logits), jnp.asarray(rep.numpy()),
+                       jnp.asarray(ilen.numpy()), jnp.asarray([4, 4, 4]),
+                       reduction="none")
+    np.testing.assert_allclose(np.asarray(ours2), ref2.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_margin_cross_entropy_reduces_to_scaled_ce():
+    logits = jnp.asarray(rs.uniform(-1, 1, (4, 10)).astype(np.float32))
+    lbl = jnp.asarray(rs.randint(0, 10, (4,)))
+    ours = F.margin_cross_entropy(logits, lbl, margin1=1.0, margin2=0.0,
+                                  margin3=0.0, scale=4.0)
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(np.asarray(logits)) * 4.0,
+        torch.tensor(np.asarray(lbl), dtype=torch.long))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    # with margins on, the target logit shrinks → loss grows
+    harder = F.margin_cross_entropy(logits, lbl, margin2=0.5, scale=4.0)
+    assert float(harder) > float(ours)
+
+
+def test_temporal_shift_semantics():
+    x = jnp.asarray(rs.randn(4, 8, 2, 2).astype(np.float32))  # N*T, T=2
+    y = F.temporal_shift(x, 2, 0.25)
+    v = np.asarray(x).reshape(2, 2, 8, 2, 2)
+    out = np.asarray(y).reshape(2, 2, 8, 2, 2)
+    np.testing.assert_allclose(out[:, 0, :2], v[:, 1, :2])    # back-shift
+    np.testing.assert_allclose(out[:, 1, :2], 0.0)
+    np.testing.assert_allclose(out[:, 1, 2:4], v[:, 0, 2:4])  # fwd-shift
+    np.testing.assert_allclose(out[:, :, 4:], v[:, :, 4:])    # untouched
+
+
+def test_sparse_sddmm_family():
+    import paddle_tpu.sparse as sp
+    import paddle_tpu.sparse.nn as spnn
+
+    d = rs.rand(4, 5).astype(np.float32)
+    d[d < 0.5] = 0
+    idx = np.nonzero(d)
+    coo = sp.sparse_coo_tensor(np.stack(idx), d[idx], d.shape)
+
+    np.testing.assert_allclose(float(sp.sum(coo)), d.sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sp.sum(coo, axis=1).todense()), d.sum(1), rtol=1e-6)
+    kept = sp.sum(coo, axis=1, keepdim=True)
+    assert kept.shape == (4, 1)
+    np.testing.assert_allclose(np.asarray(kept.todense()),
+                               d.sum(1, keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sp.slice(coo, [0, 1], [1, 1], [3, 4]).todense()),
+        d[1:3, 1:4])
+    x = rs.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sp.mask_as(jnp.asarray(x), coo).todense()),
+        np.where(d != 0, x, 0), rtol=1e-6)
+    a = rs.randn(4, 3).astype(np.float32)
+    b = rs.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sp.masked_matmul(jnp.asarray(a), jnp.asarray(b),
+                                    coo).todense()),
+        np.where(d != 0, a @ b, 0), rtol=1e-5, atol=1e-6)
+    sm = np.asarray(spnn.softmax(coo).todense())
+    ref = np.zeros_like(d)
+    for i in range(4):
+        nz = d[i] != 0
+        if nz.any():
+            e = np.exp(d[i][nz] - d[i][nz].max())
+            ref[i][nz] = e / e.sum()
+    np.testing.assert_allclose(sm, ref, rtol=1e-5)
+
+
+def test_deform_conv2d_against_conv_oracles():
+    x = rs.randn(2, 4, 9, 9).astype(np.float32)
+    wt = rs.randn(6, 4, 3, 3).astype(np.float32)
+    zero_off = np.zeros((2, 18, 7, 7), np.float32)
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(wt))
+    np.testing.assert_allclose(
+        np.asarray(V.deform_conv2d(jnp.asarray(x), jnp.asarray(zero_off),
+                                   jnp.asarray(wt))),
+        ref.numpy(), rtol=1e-4, atol=1e-4)
+    # +1 x-offset on every tap == conv over the left-shifted image
+    off1 = zero_off.copy()
+    off1[:, 1::2] = 1.0
+    xs = np.pad(x, ((0, 0), (0, 0), (0, 0), (0, 1)))[:, :, :, 1:]
+    ref1 = torch.nn.functional.conv2d(torch.tensor(xs), torch.tensor(wt))
+    np.testing.assert_allclose(
+        np.asarray(V.deform_conv2d(jnp.asarray(x), jnp.asarray(off1),
+                                   jnp.asarray(wt))),
+        ref1.numpy(), rtol=1e-4, atol=1e-4)
+    # v2 modulation mask scales linearly
+    m = np.full((2, 9, 7, 7), 0.5, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(V.deform_conv2d(jnp.asarray(x), jnp.asarray(zero_off),
+                                   jnp.asarray(wt), mask=jnp.asarray(m))),
+        0.5 * ref.numpy(), rtol=1e-4, atol=1e-4)
+    # grouped
+    wg = rs.randn(6, 2, 3, 3).astype(np.float32)
+    refg = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(wg),
+                                      groups=2)
+    np.testing.assert_allclose(
+        np.asarray(V.deform_conv2d(jnp.asarray(x), jnp.asarray(zero_off),
+                                   jnp.asarray(wg), groups=2)),
+        refg.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_psroi_pool_channel_mapping():
+    # constant-per-channel input: bin (i, j) must return exactly the value
+    # of its own channel slice c*ph*pw + i*pw + j
+    xc = np.zeros((1, 8, 8, 8), np.float32)
+    for c in range(8):
+        xc[0, c] = c
+    out = V.psroi_pool(jnp.asarray(xc), jnp.asarray([[0.0, 0, 8, 8]]),
+                       [1], 2, 1.0, 2, 2)
+    np.testing.assert_allclose(np.asarray(out)[0],
+                               np.arange(8).reshape(2, 2, 2))
+    with pytest.raises(ValueError):
+        V.psroi_pool(jnp.asarray(xc), jnp.asarray([[0.0, 0, 8, 8]]),
+                     [1], 3, 1.0, 2, 2)
+
+
+def test_matrix_nms_decay_ordering():
+    bb = jnp.asarray([[[0.0, 0, 10, 10], [1.0, 1, 11, 11],
+                       [50.0, 50, 60, 60]]])
+    sc = jnp.asarray([[[0.0, 0.0, 0.0], [0.9, 0.85, 0.8]]])
+    out, idx, nums = V.matrix_nms(bb, sc, 0.1, post_threshold=0.0,
+                                  return_index=True)
+    out = np.asarray(out)
+    assert out.shape == (3, 6) and int(np.asarray(nums)[0]) == 3
+    # top box keeps its raw score; the overlapped second decays; the
+    # distant third decays ~not at all
+    assert abs(out[0, 1] - 0.9) < 1e-6
+    assert out[1, 1] < 0.85 or out[1, 0] != 1  # decayed (order may differ)
+    scores_by_box = {tuple(r[2:4]): r[1] for r in out}
+    assert abs(scores_by_box[(50.0, 50.0)] - 0.8) < 1e-3
+    # gaussian kernel also runs
+    out2 = V.matrix_nms(bb, sc, 0.1, use_gaussian=True,
+                        return_rois_num=False)
+    assert np.asarray(out2).shape[1] == 6
+
+
+def test_tensor_sparse_bridges_and_value_counts():
+    from paddle_tpu.tensor.tensor_facade import Tensor
+
+    t = Tensor(jnp.asarray([[1.0, 0.0], [0.0, 2.0]]))
+    coo = t.to_sparse_coo()
+    np.testing.assert_allclose(np.asarray(coo.todense()),
+                               np.asarray(t.value))
+    back = Tensor(coo.todense()).to_dense()
+    np.testing.assert_allclose(np.asarray(back.value), np.asarray(t.value))
+    vals, counts = Tensor(jnp.asarray([3, 1, 3, 3, 1, 2])).value_counts()
+    np.testing.assert_array_equal(np.asarray(vals.value), [3, 1, 2])
+    np.testing.assert_array_equal(np.asarray(counts.value), [3, 2, 1])
+    # hybrid layout: sparse rows, dense columns
+    hybrid = Tensor(jnp.asarray([[1.0, 2.0], [0.0, 0.0]])).to_sparse_coo(
+        sparse_dim=1)
+    assert hybrid.indices.shape[1] == 1 and hybrid.data.shape[-1] == 2
+    np.testing.assert_allclose(np.asarray(hybrid.todense()),
+                               [[1.0, 2.0], [0.0, 0.0]])
