@@ -13,8 +13,10 @@ verdict's corrections:
       - mfu_attn:  6·N·D + 12·L·H·S²·B (adds causal-unhalved attention
         matmul FLOPs: QKᵀ and AV, fwd+2×bwd, H = hidden size);
     the headline value is mfu_6nd for comparability with round 1.
-  * the heaviest config runs under the real strategy: zero_stage=3 +
-    recompute (selective "dots" policy), not zero-1.
+  * the heaviest config runs under the fastest strategy that fits:
+    zero_stage=3 with NO remat when activations fit HBM (+4% MFU,
+    measured round 4), selective-"dots" recompute as the fallback; each
+    curve point records its ``remat`` mode.
 
 Engineering note: a hard OOM wedges the TPU client (every later allocation
 fails), so each measurement runs in its OWN subprocess (``--single``); the
@@ -53,7 +55,8 @@ def predicted_bytes(layers, vocab, batch, seq):
     return state + acts + logits + int(1e9)  # +1 GB runtime slack
 
 
-def measure(layers, vocab, batch, seq, steps, warmup, on_tpu):
+def measure(layers, vocab, batch, seq, steps, warmup, on_tpu,
+            remat: str = "dots"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -69,7 +72,9 @@ def measure(layers, vocab, batch, seq, steps, warmup, on_tpu):
     pt.seed(0)
     if on_tpu:
         cfg = llama3_8b_config(num_hidden_layers=layers, vocab_size=vocab,
-                               recompute=True, recompute_policy="dots",
+                               recompute=(remat != "none"),
+                               recompute_policy=("dots" if remat == "none"
+                                                 else remat),
                                max_position_embeddings=seq)
     else:
         cfg = tiny_llama_config()
@@ -174,11 +179,12 @@ def run_single(args):
     on_tpu = jax.devices()[0].platform == "tpu"
     step_time, loss, n, hidden, hbm = measure(
         args.layers, args.vocab, args.batch, args.seq,
-        args.steps, args.warmup, on_tpu)
+        args.steps, args.warmup, on_tpu, remat=args.remat)
     tokens = args.batch * args.seq
     n_chips = len(jax.devices())
     point = {"layers": args.layers, "vocab": args.vocab,
              "batch": args.batch, "seq": args.seq, "params": n,
+             "remat": args.remat,
              "step_time_s": round(step_time, 4),
              "tokens_per_sec_per_chip": round(tokens / step_time / n_chips),
              "hbm": hbm,
@@ -193,12 +199,12 @@ def run_single(args):
 
 
 def spawn_point(layers, vocab, batch, seq, steps, warmup, peak_flops,
-                timeout=480, extra_env=None):
+                timeout=480, extra_env=None, remat="dots"):
     cmd = [sys.executable, os.path.abspath(__file__), "--single",
            "--layers", str(layers), "--vocab", str(vocab),
            "--batch", str(batch), "--seq", str(seq),
            "--steps", str(steps), "--warmup", str(warmup),
-           "--peak-flops", str(peak_flops)]
+           "--peak-flops", str(peak_flops), "--remat", remat]
     env = dict(os.environ, **(extra_env or {}))
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
@@ -401,6 +407,10 @@ def main():
     ap.add_argument("--op", choices=["rms_norm", "flash"],
                     help="op-level perf harness: reproduce the kernel "
                          "measurement tables into BENCH_OPS.json")
+    ap.add_argument("--remat", choices=["dots", "full", "none"],
+                    default="dots",
+                    help="recompute policy for --single (none = no remat; "
+                         "+4%% MFU at depths that fit HBM)")
     args = ap.parse_args()
     if args.steps is None:
         args.steps = 50 if args.op == "rms_norm" else 20
@@ -461,8 +471,13 @@ def main():
 
     curve = []
     for d in (stretch + fits):  # stretch first; analytic pick is the backstop
+        # fastest strategy that fits wins: no-remat first (+4% MFU when
+        # activations fit HBM, measured round 4), dots-selective fallback
         p = spawn_point(d, vocab, batch, seq, args.steps, args.warmup,
-                        peak_flops)
+                        peak_flops, remat="none")
+        if p is None:
+            p = spawn_point(d, vocab, batch, seq, args.steps, args.warmup,
+                            peak_flops, remat="dots")
         if p is not None:
             curve.append(p)
             break
@@ -475,17 +490,22 @@ def main():
     # extra points come from the shallow side; a deep-narrow stretch
     # (vocab 4096, seq 1024) is still attempted and kept if it survives.
     deepest = curve[0]
+    head_remat = deepest.get("remat", "dots")
     half = max(1, deepest["layers"] // 2)
     extra = sorted({half, (deepest["layers"] + half) // 2}
                    - {deepest["layers"]}, reverse=True)
     for d in extra:
+        # same strategy as the head — the depth extrapolation fits points
+        # of ONE strategy; a point that cannot run under it is dropped
+        # rather than silently mixed in at a ~4%-different MFU level
         p = spawn_point(d, vocab, batch, seq, args.steps, args.warmup,
-                        peak_flops)
+                        peak_flops, remat=head_remat)
         if p is not None:
             curve.append(p)
     if on_tpu and not args.layers:
         p = spawn_point(deepest["layers"] + 1, 4096, batch, 1024,
-                        args.steps, args.warmup, peak_flops)
+                        args.steps, args.warmup, peak_flops,
+                        remat=head_remat)
         if p is not None:
             curve.append(p)
 
@@ -516,7 +536,8 @@ def main():
            "vs_baseline": round(head["mfu_6nd"] / 0.45, 4),
            "detail": {
                "chips": n_chips, "device": kind,
-               "strategy": {"zero_stage": 3, "recompute": "dots_selective"},
+               "strategy": {"zero_stage": 3,
+                            "recompute": head.get("remat", "dots")},
                "conventions": {
                    "mfu_6nd": "6*N*D, no attention FLOPs",
                    "mfu_attn": "6*N*D + 12*L*H*S^2*B, causal not halved",

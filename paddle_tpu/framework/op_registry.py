@@ -105,7 +105,7 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "eigh", "eigvals", "eigvalsh", "householder_product", "inv",
         "lstsq", "lu", "matrix_power", "matrix_rank", "matrix_transpose",
         "multi_dot", "norm", "pinv", "qr", "slogdet", "solve", "svd",
-        "t", "transpose", "triangular_solve",
+        "t", "transpose", "triangular_solve", "matrix_exp", "corrcoef",
     ],
     "paddle.nn.functional": [
         "avg_pool2d", "conv2d", "cross_entropy", "dropout", "embedding",
@@ -133,7 +133,6 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "soft_margin_loss", "softshrink", "softsign", "square_error_cost",
         "tanhshrink", "thresholded_relu", "triplet_margin_loss", "upsample",
         "zeropad2d", "ctc_loss", "margin_cross_entropy", "temporal_shift",
-        # work queue (absent)
         "class_center_sample",
     ],
     "paddle.incubate": [
@@ -141,6 +140,12 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         # + external flashattn integration)
         "flash_attention", "fused_rms_norm", "fused_rotary_position_embedding",
         "ring_attention", "ssd_scan", "wkv",
+        "fused_bias_dropout_residual_layer_norm",
+        "variable_length_memory_efficient_attention",
+        # work queue (absent): whole-block inference fusion — implement as
+        # a composition when a serving config needs it; Pallas only where
+        # XLA's fusion provably loses (the rms_norm lesson, BENCH_OPS.json)
+        "fused_multi_transformer",
     ],
     "paddle.distributed": [
         "all_gather", "all_reduce", "all_to_all", "barrier", "broadcast",
@@ -166,7 +171,6 @@ TARGET_SURFACE: Dict[str, List[str]] = {
     "paddle.vision.ops": [
         "box_coder", "nms", "prior_box", "roi_align", "roi_pool",
         "yolo_box", "deform_conv2d", "matrix_nms", "psroi_pool",
-        # work queue (absent): proposal-generation stages
         "distribute_fpn_proposals", "generate_proposals", "yolo_loss",
     ],
     "paddle.sparse": [
@@ -178,9 +182,8 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "deg2rad", "sum", "slice", "mask_as", "masked_matmul",
     ],
     "paddle.sparse.nn": [
-        "relu", "relu6", "leaky_relu", "softmax",
-        # work queue (absent): gather-scatter Pallas kernels
-        "attention", "conv3d", "subm_conv3d",
+        "relu", "relu6", "leaky_relu", "softmax", "attention", "conv3d",
+        "subm_conv3d",
     ],
     "paddle.Tensor": [
         # method surface of the Tensor facade (tensor_facade.py): resolved

@@ -250,6 +250,11 @@ def smoke_cases() -> Dict[str, Callable[[], Any]]:
         "unfold": lambda f: f(img, 2),
         # incubate
         "flash_attention": lambda f: f(q, q, q, causal=True),
+        "fused_bias_dropout_residual_layer_norm": lambda f: f(
+            x, y, dropout_rate=0.0),
+        "variable_length_memory_efficient_attention": lambda f: f(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(q, 1, 2),
+            jnp.swapaxes(q, 1, 2), jnp.asarray([6]), jnp.asarray([8])),
         "fused_rms_norm": lambda f: f(x),
         "fused_rotary_position_embedding": lambda f: _rope_case(f),
         "ring_attention": lambda f: _ring_case(f),
@@ -457,6 +462,21 @@ def _round4_cases(I):
         "deform_conv2d": lambda f: f(
             jnp.ones((1, 2, 5, 5)), jnp.zeros((1, 2 * 4, 4, 4)),
             jnp.ones((2, 2, 2, 2)) * 0.1),
+        "class_center_sample": lambda f: f(jnp.asarray([1, 3]), 8, 4),
+        "matrix_exp": lambda f: f(jnp.eye(3) * 0.1),
+        "corrcoef": lambda f: f(jnp.asarray(
+            np.random.default_rng(3).normal(size=(3, 8)), jnp.float32)),
+        "distribute_fpn_proposals": lambda f: f(
+            boxes * 16.0, 2, 5, 4, 224, rois_num=[2]),
+        "generate_proposals": lambda f: f(
+            jnp.ones((1, 2, 3, 3)) * 0.5,
+            jnp.zeros((1, 8, 3, 3)), jnp.asarray([[24, 24]]),
+            jnp.broadcast_to(jnp.asarray([2.0, 2.0, 10.0, 10.0]),
+                             (3, 3, 2, 4)), jnp.ones((3, 3, 2, 4))),
+        "yolo_loss": lambda f: f(
+            jnp.ones((1, 2 * 7, 2, 2)) * 0.1,
+            jnp.asarray([[[0.5, 0.5, 0.3, 0.3]]]), jnp.asarray([[1]]),
+            [2, 3, 4, 5], [0, 1], 2, 0.7, 16),
         # -- sparse (qualified: names collide with dense namespaces)
         "paddle.sparse:sparse_coo_tensor": lambda f: f(
             jnp.asarray([[0, 1], [1, 2]]), jnp.asarray([1.0, 2.0]), (2, 3)),
@@ -483,6 +503,11 @@ def _round4_cases(I):
         "paddle.sparse:masked_matmul": lambda f: f(
             jnp.ones((2, 3)), jnp.ones((3, 3)), _coo()),
         "paddle.sparse.nn:softmax": lambda f: f(_coo()),
+        "paddle.sparse.nn:attention": lambda f: f(
+            jnp.ones((1, 1, 2, 4)), jnp.ones((1, 1, 2, 4)),
+            jnp.ones((1, 1, 2, 4)), _sq_coo()),
+        "paddle.sparse.nn:conv3d": lambda f: _sparse_conv_case(f),
+        "paddle.sparse.nn:subm_conv3d": lambda f: _sparse_conv_case(f),
     }
     for name in ("sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
                  "atanh", "sqrt", "square", "log1p", "abs", "expm1", "neg",
@@ -501,6 +526,20 @@ def _istft_case(f):
     # compiled program (see the chip-quirk note at the "istft" case)
     return jax.jit(lambda s: f(stft(s, 16), 16))(
         jnp.ones((64,), jnp.float32))
+
+
+def _sq_coo():
+    """Square (2, 2) pattern with every row occupied (sparse attention)."""
+    from .. import sparse as sp
+    return sp.sparse_coo_tensor(
+        jnp.asarray([[0, 1], [0, 1]]), jnp.asarray([1.0, 1.0]), (2, 2))
+
+
+def _sparse_conv_case(f):
+    from jax.experimental import sparse as jsparse
+    dense = jnp.zeros((1, 3, 3, 3, 2)).at[0, 1, 1, 1].set(1.0)
+    x = jsparse.BCOO.fromdense(dense, n_dense=1)
+    return f(x, jnp.ones((3, 3, 3, 2, 2)) * 0.1, padding=1)
 
 
 def _scaled_coo():

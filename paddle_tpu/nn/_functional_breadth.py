@@ -44,6 +44,7 @@ __all__ = [
     "dropout2d", "dropout3d", "label_smooth", "sequence_mask",
     # round-4 queue shrink
     "temporal_shift", "margin_cross_entropy", "ctc_loss",
+    "class_center_sample",
 ]
 
 
@@ -890,3 +891,33 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths,
     if norm_by_times:
         loss = loss / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
     return _reduce(loss, reduction)
+
+
+def class_center_sample(label, num_classes: int, num_samples: int,
+                        group=None):
+    """Class-center sampling for margin-softmax heads (parity:
+    F.class_center_sample, PartialFC): keep every positive class plus
+    uniformly-sampled negative centers up to ``num_samples``; labels are
+    remapped into the sampled index space.
+
+    Host-eager (the sampled set's composition is data-dependent, as in the
+    reference); the negative draw uses the framework key chain so runs are
+    reproducible from ``paddle_tpu.seed``.  Returns (remapped_label,
+    sampled_class_index) with sampled_class_index sorted ascending.
+    """
+    import numpy as np
+
+    lbl = np.asarray(label)
+    positives = np.unique(lbl)
+    if len(positives) >= num_samples:
+        sampled = np.sort(positives)
+    else:
+        negatives = np.setdiff1d(np.arange(num_classes), positives,
+                                 assume_unique=True)
+        key = _random.site_key()
+        perm = np.asarray(jax.random.permutation(key, len(negatives)))
+        extra = negatives[perm[:num_samples - len(positives)]]
+        sampled = np.sort(np.concatenate([positives, extra]))
+    remap = np.searchsorted(sampled, lbl)
+    return (jnp.asarray(remap.astype(np.int64)),
+            jnp.asarray(sampled.astype(np.int64)))

@@ -12,9 +12,13 @@ testing).
 from .attention import flash_attention, flash_attention_reference
 from .norms import rms_norm, rms_norm_reference
 from .rope import apply_rope, build_rope_cache, fused_rope
+from .fused import (fused_bias_dropout_residual_layer_norm,
+                    variable_length_memory_efficient_attention)
 
 __all__ = [
     "flash_attention", "flash_attention_reference",
     "rms_norm", "rms_norm_reference",
     "apply_rope", "build_rope_cache", "fused_rope",
+    "fused_bias_dropout_residual_layer_norm",
+    "variable_length_memory_efficient_attention",
 ]

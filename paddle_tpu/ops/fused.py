@@ -1,0 +1,77 @@
+"""Composite "fused" ops (parity: paddle.incubate.nn.functional fusions).
+
+The reference hand-writes these as single CUDA kernels
+(paddle/phi/kernels/fusion/gpu/, upstream layout).  Here they are
+*compositions*: under jit XLA fuses the elementwise chain into its
+neighbours, which is exactly the design stance SURVEY §7 prescribes — and
+the measured lesson of BENCH_OPS.json (the hand-written Pallas rms_norm
+lost to XLA at every shape once dispatch latency was excluded).  The
+names exist for API parity and as the contract a future Pallas kernel
+would have to beat, not because a kernel hides behind them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_bias_dropout_residual_layer_norm",
+           "variable_length_memory_efficient_attention"]
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate: float = 0.5, ln_epsilon: float = 1e-5,
+        training: bool = True):
+    """layer_norm(residual + dropout(x + bias)) — the transformer block's
+    post-attention epilogue as one jit-fusable expression."""
+    from ..nn import functional as F
+
+    y = x if bias is None else x + bias
+    y = F.dropout(y, p=dropout_rate, training=training)
+    y = residual + y
+    return F.layer_norm(y, [y.shape[-1]], ln_scale, ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None,
+        scale: Optional[float] = None, causal: bool = False):
+    """Variable-length attention (parity: paddle.incubate.nn.functional.
+    variable_length_memory_efficient_attention, the cutlass fMHA wrapper).
+
+    Per-row valid lengths become position-range masks routed into the
+    flash kernel via segment ids where eligible (padding positions get a
+    sentinel segment so they attend nowhere) — the same in-kernel masking
+    machinery the varlen training path uses; the XLA fallback materialises
+    the mask.  query/key/value: (B, H, S, D) (the reference's layout);
+    seq_lens/kv_seq_lens: (B,) valid lengths.  Returns (B, H, S, D).
+    """
+    from .attention import flash_attention
+
+    b, h, s, d = query.shape
+    skv = key.shape[2]
+    # (B, S, H, D) is our kernel layout
+    q = jnp.swapaxes(query, 1, 2)
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    pos_q = jnp.arange(s)[None, :]
+    pos_k = jnp.arange(skv)[None, :]
+    # valid rows: segment 1; padding: distinct sentinels (2 for q, 3 for
+    # kv) so cross-attention between padding rows is masked too
+    seg_q = jnp.where(pos_q < jnp.asarray(seq_lens)[:, None], 1, 2)
+    seg_k = jnp.where(pos_k < jnp.asarray(kv_seq_lens)[:, None], 1, 3)
+    # segment ids ALWAYS apply (padding keys must never enter the
+    # softmax); an additive mask composes with them — the dispatcher
+    # folds both into the reference path when a custom mask forces it off
+    # the kernel
+    out = flash_attention(q, k, v, attn_mask=mask, causal=causal,
+                          scale=scale, segment_ids=seg_q,
+                          kv_segment_ids=seg_k)
+    # zero the padding query rows (their softmax saw only masked keys)
+    out = jnp.where((pos_q < jnp.asarray(seq_lens)[:, None])[..., None,
+                                                             None],
+                    out, 0.0)
+    return jnp.swapaxes(out, 1, 2)

@@ -6,7 +6,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
-__all__ = ["relu", "relu6", "leaky_relu", "softmax"]
+__all__ = ["relu", "relu6", "leaky_relu", "softmax",
+           "attention", "conv3d", "subm_conv3d"]
 
 
 def _unary(fn):
@@ -47,3 +48,142 @@ def softmax(x, axis: int = -1):
     return jsparse.BCOO((shifted / row_sum[rows], x.indices),
                         shape=x.shape, indices_sorted=x.indices_sorted,
                         unique_indices=x.unique_indices)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None):
+    """Sparse-pattern attention (parity: paddle.sparse.nn.functional.
+    attention): scores are computed ONLY at ``sparse_mask``'s nonzero
+    (query, key) pairs — the SDDMM → sparse-softmax → SpMM pipeline this
+    module already owns, composed.  O(nse·D) instead of O(L²·D).
+
+    query/key/value: (B, H, L, D) dense; sparse_mask: a 2-D (L, L) BCOO/
+    BCSR pattern shared across batch-heads (the reference's per-(b,h) CSR
+    with identical row splits).  Additive masks: key_padding_mask (B, L),
+    attn_mask (L, L) — applied at the sampled coordinates.
+    Returns (B, H, L, D).
+    """
+    if isinstance(sparse_mask, jsparse.BCSR):
+        sparse_mask = sparse_mask.to_bcoo()
+    b, hn, L, d = query.shape
+    scale = d ** -0.5
+    rows = sparse_mask.indices[:, 0]
+    cols = sparse_mask.indices[:, 1]
+
+    def one(q, k, v, bias):
+        """All-dense per-(batch, head) chain so the whole thing vmaps
+        into ONE fused program: SDDMM as a gathered row-dot, softmax via
+        segment max/sum on the row ids, SpMM as a scatter-add."""
+        s = jnp.einsum("nk,nk->n", q[rows] * scale, k[cols]) + bias
+        row_max = jax.ops.segment_max(s, rows, num_segments=L,
+                                      indices_are_sorted=False)
+        e = jnp.exp(s - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=L,
+                                    indices_are_sorted=False)
+        p = e / jnp.maximum(denom[rows], 1e-37)
+        return jnp.zeros((L, d), q.dtype).at[rows].add(p[:, None] * v[cols])
+
+    am = (jnp.asarray(attn_mask)[rows, cols]              # (nse,)
+          if attn_mask is not None else jnp.zeros((), jnp.float32))
+    kp = (jnp.asarray(key_padding_mask)[:, cols]          # (B, nse)
+          if key_padding_mask is not None
+          else jnp.zeros((b, 1), jnp.float32))
+    bias = jnp.broadcast_to((am + kp)[:, None], (b, hn, len(rows)))
+    return jax.vmap(jax.vmap(one))(query, key, value, bias)
+
+
+def _sparse_conv3d_impl(x, weight, bias, stride, padding, dilation,
+                        groups, subm):
+    """Shared gather-scatter sparse 3-D convolution.
+
+    x: BCOO with 4 sparse dims (N, D, H, W) + 1 dense channel dim;
+    weight: (kd, kh, kw, Cin/groups, Cout), paddle's NDHWC layout.
+    Coordinate matching (the rulebook/hashmap the reference's sparse
+    kernels build on GPU) runs host-side — output coordinates are
+    data-dependent; the per-tap contraction is a batched (nse, Cin) @
+    (Cin, Cout) matmul on device.  Submanifold mode pins the output
+    coordinate set to the input's, the sparsity-preserving variant.
+    """
+    import numpy as np
+
+    if isinstance(x, jsparse.BCSR):
+        raise ValueError("sparse conv3d expects a COO tensor (NDHWC)")
+    if x.n_dense != 1 or x.indices.shape[1] != 4:
+        raise ValueError("x must have 4 sparse dims (N,D,H,W) + dense C; "
+                         "build via Tensor.to_sparse_coo(sparse_dim=4)")
+    if groups != 1:
+        raise NotImplementedError("sparse conv3d: groups > 1")
+    kd, kh, kw, cin, cout = weight.shape
+    st = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dl = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    n, D, H, W = x.shape[:4]
+    coords = np.asarray(x.indices)                      # (nse, 4)
+    vals = x.data                                       # (nse, Cin)
+
+    if subm:
+        if st != (1, 1, 1):
+            raise ValueError("subm_conv3d requires stride 1")
+        out_dims = (D, H, W)
+    else:
+        out_dims = tuple(
+            (s + 2 * pd[i] - dl[i] * (k - 1) - 1) // st[i] + 1
+            for i, (s, k) in enumerate(zip((D, H, W), (kd, kh, kw))))
+    out_shape = (n,) + out_dims + (cout,)
+
+    # per-tap geometry, computed once: (src row ids, output coords)
+    taps = []
+    for ti in range(kd):
+        for tj in range(kh):
+            for tk in range(kw):
+                oc = coords[:, 1:] + np.asarray(pd) - \
+                    np.asarray([ti * dl[0], tj * dl[1], tk * dl[2]])
+                ok = (oc % np.asarray(st) == 0).all(1)
+                oc = oc // np.asarray(st)
+                ok &= (oc >= 0).all(1) & (oc < np.asarray(out_dims)).all(1)
+                src = np.nonzero(ok)[0]
+                taps.append(((ti, tj, tk), src,
+                             np.concatenate([coords[src, :1], oc[src]],
+                                            axis=1)))
+
+    if subm:
+        out_coords = coords
+    else:
+        all_oc = [oc for _, _, oc in taps if len(oc)]
+        out_coords = (np.unique(np.concatenate(all_oc, axis=0), axis=0)
+                      if all_oc else np.zeros((0, 4), coords.dtype))
+
+    key = np.ravel_multi_index(out_coords.T, (n,) + out_dims)
+    lookup = {k: i for i, k in enumerate(key.tolist())}
+    m = len(out_coords)
+    out_vals = jnp.zeros((m, cout), vals.dtype)
+    for (ti, tj, tk), src, oc in taps:
+        if src.size == 0:
+            continue
+        tgt_key = np.ravel_multi_index(oc.T, (n,) + out_dims)
+        tgt = np.asarray([lookup.get(k, -1) for k in tgt_key.tolist()])
+        hit = tgt >= 0                              # subm: drop off-pattern
+        if not hit.any():
+            continue
+        contrib = vals[jnp.asarray(src[hit])] @ weight[ti, tj, tk]
+        out_vals = out_vals.at[jnp.asarray(tgt[hit])].add(contrib)
+    if bias is not None:
+        out_vals = out_vals + bias
+    return jsparse.BCOO((out_vals, jnp.asarray(out_coords)),
+                        shape=out_shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NDHWC"):
+    """Sparse 3-D convolution (parity: paddle.sparse.nn.functional.conv3d)."""
+    return _sparse_conv3d_impl(x, weight, bias, stride, padding, dilation,
+                               groups, subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups: int = 1, data_format: str = "NDHWC"):
+    """Submanifold sparse conv (parity: subm_conv3d): output pattern ==
+    input pattern, the sparsity-preserving 3-D conv of MinkowskiNet/
+    SECOND-style point-cloud backbones."""
+    return _sparse_conv3d_impl(x, weight, bias, stride, padding, dilation,
+                               groups, subm=True)

@@ -21,6 +21,8 @@ __all__ = [
     "lu", "qr", "svd", "eig",
     "eigh", "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq",
     "multi_dot", "matrix_transpose", "householder_product",
+    # round-4 additions
+    "matrix_exp", "corrcoef",
 ]
 
 
@@ -176,3 +178,16 @@ def lu(x, pivot: bool = True, get_infos: bool = False):
         info = jnp.zeros(x.shape[:-2], jnp.int32)
         return lu_mat, piv, info
     return lu_mat, piv
+
+
+# -- round-4 additions -------------------------------------------------------
+
+def matrix_exp(x):
+    """Matrix exponential (parity: paddle.linalg.matrix_exp) — XLA's
+    scaling-and-squaring Padé path via jax.scipy."""
+    return jax.scipy.linalg.expm(x)
+
+
+def corrcoef(x, rowvar: bool = True):
+    """Correlation matrix (parity: paddle.linalg.corrcoef)."""
+    return jnp.corrcoef(x, rowvar=rowvar)

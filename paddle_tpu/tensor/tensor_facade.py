@@ -116,15 +116,13 @@ class Tensor:
         """Dense → sparse COO (host-eager: nse is data-dependent).
         ``sparse_dim`` < ndim gives paddle's hybrid layout: leading dims
         sparse, trailing dims dense (BCOO n_dense)."""
-        import numpy as np
-
         from jax.experimental import sparse as jsparse
-        arr = np.asarray(self.value)
-        n_dense = 0 if sparse_dim is None else arr.ndim - sparse_dim
+        ndim = self.value.ndim
+        n_dense = 0 if sparse_dim is None else ndim - sparse_dim
         if n_dense < 0 or (sparse_dim is not None and sparse_dim < 1):
-            raise ValueError(f"sparse_dim must be in [1, {arr.ndim}], "
+            raise ValueError(f"sparse_dim must be in [1, {ndim}], "
                              f"got {sparse_dim}")
-        return jsparse.BCOO.fromdense(jnp.asarray(arr), n_dense=n_dense)
+        return jsparse.BCOO.fromdense(self.value, n_dense=n_dense)
 
     def to(self, *args, **kwargs):
         """paddle.Tensor.to(dtype) / .to(device): dtype strings cast;

@@ -10,9 +10,6 @@ vmappable. Box counts are padding-tolerant: callers pad with zero-area
 boxes and mask on the returned keep/score arrays, the standard TPU
 detection recipe.
 
-Not yet implemented (visible in the op registry's absent list):
-distribute_fpn_proposals, generate_proposals, yolo_loss — see
-framework/op_registry.py.
 """
 
 from __future__ import annotations
@@ -21,7 +18,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "prior_box",
-           "yolo_box", "matrix_nms", "psroi_pool", "deform_conv2d"]
+           "yolo_box", "matrix_nms", "psroi_pool", "deform_conv2d",
+           "distribute_fpn_proposals", "generate_proposals", "yolo_loss"]
 
 
 def _iou_matrix(boxes):
@@ -506,3 +504,296 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level: int, max_level: int,
+                             refer_level: int, refer_scale: int,
+                             pixel_offset: bool = False, rois_num=None):
+    """Assign RoIs to FPN levels by scale (parity: the FPN paper's
+    k = k0 + log2(sqrt(area)/refer_scale)).  Host-eager: per-level counts
+    are data-dependent, the same dynamic-output constraint as the
+    reference's CUDA kernel.
+
+    ``rois_num``: per-IMAGE roi counts; each level's rois stay grouped by
+    image and ``rois_num_per_level`` is a (B,) count per level — the
+    layout downstream per-image ``roi_align`` consumes.  Returns
+    (multi_rois, restore_index[, rois_num_per_level])."""
+    import numpy as np
+
+    rois = np.asarray(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    area = np.maximum(rois[:, 2] - rois[:, 0] + off, 0) * \
+        np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(area)
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    img_of = (np.repeat(np.arange(len(rois_num)), np.asarray(rois_num))
+              if rois_num is not None else np.zeros(len(rois), np.int64))
+    n_img = int(img_of.max()) + 1 if len(rois) else 1
+
+    multi_rois, order, per_level_counts = [], [], []
+    for level in range(min_level, max_level + 1):
+        sel = lvl == level
+        # group by image within the level (stable: original order kept)
+        idx = np.concatenate(
+            [np.nonzero(sel & (img_of == b))[0] for b in range(n_img)]
+        ) if sel.any() else np.zeros(0, np.int64)
+        multi_rois.append(jnp.asarray(rois[idx.astype(np.int64)]))
+        order.extend(idx.tolist())
+        per_level_counts.append(
+            [int((sel & (img_of == b)).sum()) for b in range(n_img)])
+    restore = np.empty(len(rois), np.int32)
+    restore[np.asarray(order, np.int64)] = np.arange(len(rois))
+    out = [multi_rois, jnp.asarray(restore.reshape(-1, 1))]
+    if rois_num is not None:
+        out.append([jnp.asarray(np.asarray(c, np.int32))
+                    for c in per_level_counts])
+    return tuple(out)
+
+
+def _greedy_nms_eta(boxes, scores, thresh, eta):
+    """Host-side greedy NMS with paddle's in-loop adaptive threshold."""
+    import numpy as np
+
+    area = (np.maximum(boxes[:, 2] - boxes[:, 0], 0)
+            * np.maximum(boxes[:, 3] - boxes[:, 1], 0))
+    order = np.argsort(-scores)
+    sup = np.zeros(len(boxes), bool)
+    keep = []
+    adaptive = thresh
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        lt = np.maximum(boxes[i, :2], boxes[:, :2])
+        rb = np.minimum(boxes[i, 2:], boxes[:, 2:])
+        wh = np.maximum(rb - lt, 0)
+        inter = wh[:, 0] * wh[:, 1]
+        union = area[i] + area - inter
+        iou = np.where(union > 0, inter / union, 0.0)
+        sup |= iou > adaptive
+        sup[i] = True
+        if adaptive > 0.5:
+            adaptive *= eta
+    return np.asarray(keep, np.int64)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n: int = 6000, post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1,
+                       eta: float = 1.0, pixel_offset: bool = False,
+                       return_rois_num: bool = True):
+    """RPN proposal generation (parity: paddle.vision.ops.
+    generate_proposals): decode anchor deltas, clip to the image, drop
+    tiny boxes, top-k by objectness, NMS.  A host-eager composition of
+    box_coder-style decoding and :func:`nms` — the reference's fused CUDA
+    pipeline unrolled into the ops this module already owns.
+
+    scores: (N, A, H, W); bbox_deltas: (N, 4*A, H, W);
+    anchors/variances: (H, W, A, 4).
+    """
+    import numpy as np
+
+    n, a, h, w = scores.shape
+    sc = np.asarray(scores).transpose(0, 2, 3, 1).reshape(n, -1)
+    dl = np.asarray(bbox_deltas).reshape(n, a, 4, h, w)
+    dl = dl.transpose(0, 3, 4, 1, 2).reshape(n, -1, 4)
+    an = np.asarray(anchors).reshape(-1, 4)
+    va = np.asarray(variances).reshape(-1, 4)
+    img = np.asarray(img_size)
+    off = 1.0 if pixel_offset else 0.0
+
+    aw = an[:, 2] - an[:, 0] + off
+    ah = an[:, 3] - an[:, 1] + off
+    ax = an[:, 0] + aw * 0.5
+    ay = an[:, 1] + ah * 0.5
+
+    rois_out, scores_out, num_out = [], [], []
+    for b in range(n):
+        d = dl[b]
+        cx = va[:, 0] * d[:, 0] * aw + ax
+        cy = va[:, 1] * d[:, 1] * ah + ay
+        bw = np.exp(np.minimum(va[:, 2] * d[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(va[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - off, cy + bh * 0.5 - off], axis=1)
+        ih, iw = img[b, 0], img[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        valid = np.nonzero((ws >= min_size) & (hs >= min_size))[0]
+        s = sc[b][valid]
+        order = valid[np.argsort(-s)][:pre_nms_top_n]
+        if len(order) == 0:   # every candidate below min_size
+            rois_out.append(np.zeros((0, 4), np.float32))
+            scores_out.append(np.zeros(0, np.float32))
+            num_out.append(0)
+            continue
+        if eta < 1.0:
+            # adaptive NMS: the threshold decays DURING greedy selection
+            # (after each kept box, while it stays > 0.5) — progressively
+            # stricter suppression, paddle's in-loop eta semantics
+            keep = _greedy_nms_eta(boxes[order], sc[b][order], nms_thresh,
+                                   eta)[:post_nms_top_n]
+        else:
+            keep = np.asarray(nms(jnp.asarray(boxes[order]), nms_thresh,
+                                  jnp.asarray(sc[b][order])
+                                  ))[:post_nms_top_n]
+        rois_out.append(boxes[order][keep])
+        scores_out.append(sc[b][order][keep])
+        num_out.append(len(keep))
+    rois = jnp.asarray(np.concatenate(rois_out, axis=0)
+                       if rois_out else np.zeros((0, 4), np.float32))
+    scores_kept = jnp.asarray(np.concatenate(scores_out)
+                              if scores_out else np.zeros(0, np.float32))
+    if return_rois_num:
+        return rois, scores_kept, jnp.asarray(np.asarray(num_out, np.int32))
+    return rois, scores_kept
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num: int,
+              ignore_thresh: float, downsample_ratio: int, gt_score=None,
+              use_label_smooth: bool = True, scale_x_y: float = 1.0):
+    """YOLOv3 loss for one detection head (parity: paddle.vision.ops.
+    yolo_loss / fluid yolov3_loss).
+
+    Vectorised target assignment: a gt matches THIS head's anchor a iff a
+    is the argmax-IoU anchor over the FULL anchor set (shape-only IoU at
+    the origin) and a ∈ anchor_mask; objectness negatives are ignored
+    where the best-gt IoU of a prediction exceeds ``ignore_thresh`` — the
+    standard decomposition, expressed as dense masked reductions (no
+    per-gt loops; B is the only vmapped axis).  ``gt_score`` (mixup
+    weighting) becomes the objectness target value; ``scale_x_y`` enters
+    the xy decode exactly as in :func:`yolo_box`.
+
+    x: (N, M*(5+C), H, W); gt_box: (N, G, 4) in [0, 1] x/y/w/h (center
+    form); gt_label: (N, G) int; anchors: flat full list; anchor_mask:
+    indices of this head's anchors.  Returns (N,) loss.
+    """
+    n, _, h, w = x.shape
+    m = len(anchor_mask)
+    an_full = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_full[jnp.asarray(anchor_mask)]
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+
+    x = x.reshape(n, m, 5 + class_num, h, w)
+    px, py = x[:, :, 0], x[:, :, 1]            # raw (pre-sigmoid)
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    gw = gt_box[..., 2]
+    gh = gt_box[..., 3]
+    valid = (gw > 0) & (gh > 0)                                   # (N, G)
+
+    # anchor assignment: shape-only IoU vs the FULL anchor set
+    gw_abs = gw * input_w
+    gh_abs = gh * input_h
+    inter = (jnp.minimum(gw_abs[..., None], an_full[None, None, :, 0])
+             * jnp.minimum(gh_abs[..., None], an_full[None, None, :, 1]))
+    union = (gw_abs * gh_abs)[..., None] + \
+        (an_full[:, 0] * an_full[:, 1])[None, None] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)
+    in_mask = jnp.stack([best_anchor == aidx for aidx in anchor_mask],
+                        axis=-1)                                  # (N, G, M)
+
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # scatter gt into dense (N, M, H, W) target maps — additive with
+    # pre-masked values, then mean-normalised by the hit count: .set with
+    # duplicate indices is order-undefined (a padded gt at cell (0, 0)
+    # could clobber a real target), .add is deterministic
+    sel = (in_mask & valid[..., None]).astype(jnp.float32)        # (N, G, M)
+    bidx = jnp.arange(n)[:, None, None]
+    midx = jnp.arange(m)[None, None, :]
+    count = jnp.zeros((n, m, h, w)).at[
+        bidx, midx, gj[..., None], gi[..., None]].add(sel, mode="drop")
+    denom = jnp.maximum(count, 1.0)
+
+    def scatter_m(vals_m):
+        """vals_m: (N, G, M) masked-add → per-cell mean over matched gts."""
+        acc = jnp.zeros((n, m, h, w)).at[
+            bidx, midx, gj[..., None], gi[..., None]].add(
+            sel * vals_m, mode="drop")
+        return acc / denom
+
+    def scatter(vals):
+        return scatter_m(vals[..., None] * jnp.ones((1, 1, m)))
+
+    score = (jnp.asarray(gt_score, jnp.float32) if gt_score is not None
+             else jnp.ones((n, gt_box.shape[1])))
+    obj_t = jnp.minimum(count, 1.0)            # any match → positive cell
+    obj_target = scatter(score)                # mixup/soft objectness value
+    tx = scatter(gt_box[..., 0] * w - gi.astype(jnp.float32))
+    ty = scatter(gt_box[..., 1] * h - gj.astype(jnp.float32))
+    # tw/th per matched anchor need the anchor dim: log(g / anchor)
+    tw_g = jnp.log(jnp.maximum(gw_abs[..., None] / an[None, None, :, 0],
+                               1e-9))
+    th_g = jnp.log(jnp.maximum(gh_abs[..., None] / an[None, None, :, 1],
+                               1e-9))
+    box_scale = 2.0 - gw * gh                                     # (N, G)
+
+    tw = scatter_m(tw_g)
+    th = scatter_m(th_g)
+    scale_t = scatter(box_scale)
+    # class targets scatter as ONE-HOTS: colliding gts yield a soft
+    # distribution over their classes — a scatter-mean of integer labels
+    # would invent a class neither gt has
+    cls_oh_g = jax.nn.one_hot(gt_label.astype(jnp.int32), class_num)
+    cls_acc = jnp.zeros((n, m, h, w, class_num)).at[
+        bidx, midx, gj[..., None], gi[..., None]].add(
+        sel[..., None] * cls_oh_g[:, :, None, :], mode="drop")
+    cls_soft = jnp.moveaxis(cls_acc / denom[..., None], -1, 2)
+
+    # ignore mask: predicted boxes vs any gt, IoU > thresh → not negative
+    bias_xy = (scale_x_y - 1.0) * 0.5
+    gx_grid = (jax.nn.sigmoid(px) * scale_x_y - bias_xy
+               + jnp.arange(w)[None, None, None, :]) / w
+    gy_grid = (jax.nn.sigmoid(py) * scale_x_y - bias_xy
+               + jnp.arange(h)[None, None, :, None]) / h
+    pw_abs = jnp.exp(jnp.clip(pw, -10, 10)) * an[None, :, 0, None, None] \
+        / input_w
+    ph_abs = jnp.exp(jnp.clip(ph, -10, 10)) * an[None, :, 1, None, None] \
+        / input_h
+
+    def iou_pred_gt(bx, by, bw_, bh_, g):
+        """pred (M, H, W) vs gt (G, 4) → (G, M, H, W) IoU."""
+        px1, px2 = bx - bw_ / 2, bx + bw_ / 2
+        py1, py2 = by - bh_ / 2, by + bh_ / 2
+        gx1 = (g[:, 0] - g[:, 2] / 2)[:, None, None, None]
+        gx2 = (g[:, 0] + g[:, 2] / 2)[:, None, None, None]
+        gy1 = (g[:, 1] - g[:, 3] / 2)[:, None, None, None]
+        gy2 = (g[:, 1] + g[:, 3] / 2)[:, None, None, None]
+        iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+        ih = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+        inter = iw * ih
+        union = bw_ * bh_ + (g[:, 2] * g[:, 3])[:, None, None, None] - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    best_iou = jax.vmap(iou_pred_gt)(gx_grid, gy_grid, pw_abs, ph_abs,
+                                     gt_box)          # (N, G, M, H, W)
+    best_iou = jnp.max(jnp.where(valid[:, :, None, None, None], best_iou,
+                                 0.0), axis=1)        # (N, M, H, W)
+    ignore = (best_iou > ignore_thresh) & (obj_t == 0)
+
+    def bce(logit, target):
+        return (jnp.maximum(logit, 0) - logit * target
+                + jnp.logaddexp(0.0, -jnp.abs(logit)))
+
+    pos = obj_t
+    loss_xy = pos * scale_t * (bce(px, tx) + bce(py, ty))
+    loss_wh = pos * scale_t * 0.5 * (jnp.abs(pw - tw) + jnp.abs(ph - th))
+    loss_obj = jnp.where(ignore, 0.0, bce(pobj, obj_target * obj_t))
+    if use_label_smooth:
+        smooth = 1.0 / max(class_num, 40)
+        cls_target = cls_soft * (1.0 - smooth) + smooth / class_num
+    else:
+        cls_target = cls_soft
+    loss_cls = pos[:, :, None] * bce(pcls, cls_target)
+    total = (loss_xy.sum(axis=(1, 2, 3)) + loss_wh.sum(axis=(1, 2, 3))
+             + loss_obj.sum(axis=(1, 2, 3)) + loss_cls.sum(axis=(1, 2, 3, 4)))
+    return total

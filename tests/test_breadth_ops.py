@@ -741,3 +741,199 @@ def test_tensor_sparse_bridges_and_value_counts():
     assert hybrid.indices.shape[1] == 1 and hybrid.data.shape[-1] == 2
     np.testing.assert_allclose(np.asarray(hybrid.todense()),
                                [[1.0, 2.0], [0.0, 0.0]])
+
+
+# ---------------------------------------------------------------------------
+# round-4 final queue pass: RPN pieces, yolo_loss, class_center_sample,
+# sparse attention / conv3d, linalg matrix_exp / corrcoef
+# ---------------------------------------------------------------------------
+
+def test_distribute_fpn_proposals_restores_order():
+    rois = np.abs(rs.rand(12, 4).astype(np.float32)) * 100
+    rois[:, 2:] = rois[:, :2] + np.array([[4.0, 4.0]]) * \
+        (2.0 ** rs.randint(0, 6, (12, 1)))
+    multi, restore, nums = V.distribute_fpn_proposals(
+        jnp.asarray(rois), 2, 5, 4, 224, rois_num=[12])
+    cat = np.concatenate([np.asarray(m) for m in multi])
+    np.testing.assert_allclose(cat[np.asarray(restore)[:, 0]], rois)
+    assert sum(int(np.asarray(c).sum()) for c in nums) == 12
+    # scale monotonicity: levels assigned by sqrt(area)
+    areas = [np.prod(np.asarray(m)[:, 2:] - np.asarray(m)[:, :2], axis=1)
+             for m in multi if len(np.asarray(m))]
+    maxima = [a.max() for a in areas]
+    assert maxima == sorted(maxima)
+    # batched: rois stay grouped by image within each level, and the
+    # per-level counts are per-image
+    multi2, restore2, nums2 = V.distribute_fpn_proposals(
+        jnp.asarray(rois), 2, 5, 4, 224, rois_num=[5, 7])
+    img_of = np.repeat([0, 1], [5, 7])
+    for lvl_rois, lvl_counts in zip(multi2, nums2):
+        counts = np.asarray(lvl_counts)
+        assert counts.shape == (2,)
+        assert counts.sum() == len(np.asarray(lvl_rois))
+    cat2 = np.concatenate([np.asarray(m) for m in multi2])
+    np.testing.assert_allclose(cat2[np.asarray(restore2)[:, 0]], rois)
+
+
+def test_generate_proposals_clips_and_caps():
+    N, A, H, W = 2, 3, 4, 4
+    scores = jnp.asarray(rs.rand(N, A, H, W).astype(np.float32))
+    deltas = jnp.asarray(rs.randn(N, 4 * A, H, W).astype(np.float32) * 0.1)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                cx, cy, s = j * 8 + 4, i * 8 + 4, 8 * (a + 1)
+                anchors[i, j, a] = [cx - s / 2, cy - s / 2,
+                                    cx + s / 2, cy + s / 2]
+    rois, sc, num = V.generate_proposals(
+        scores, deltas, jnp.asarray([[32, 32], [32, 32]]),
+        jnp.asarray(anchors), jnp.ones((H, W, A, 4), jnp.float32),
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7)
+    b = np.asarray(rois)
+    assert (np.asarray(num) <= 5).all() and b.shape[0] == np.asarray(num).sum()
+    assert b.min() >= 0 and b.max() <= 32
+    assert (np.asarray(sc).shape[0] == b.shape[0])
+    # scores come back sorted per image (nms order)
+    ofs = 0
+    for k in np.asarray(num):
+        seg = np.asarray(sc)[ofs:ofs + k]
+        assert (np.diff(seg) <= 1e-6).all()
+        ofs += k
+
+
+def test_yolo_loss_target_sensitivity():
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+               59, 119, 116, 90, 156, 198, 373, 326]
+    x = jnp.asarray(rs.randn(2, 27, 4, 4).astype(np.float32) * 0.1)
+    gtb = jnp.asarray([[[0.5, 0.5, 0.3, 0.4], [0.2, 0.3, 0.1, 0.1]],
+                       [[0.7, 0.2, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]]])
+    gtl = jnp.asarray([[1, 3], [2, 0]])
+    loss = np.asarray(V.yolo_loss(x, gtb, gtl, anchors, [0, 1, 2], 4,
+                                  0.7, 8))
+    assert loss.shape == (2,) and np.isfinite(loss).all() and (loss > 0).all()
+    # no gt → objectness-only loss, strictly smaller
+    loss0 = np.asarray(V.yolo_loss(x, jnp.zeros((2, 2, 4)),
+                                   jnp.zeros((2, 2), jnp.int32), anchors,
+                                   [0, 1, 2], 4, 0.7, 8))
+    assert (loss0 < loss).all()
+    # gradient flows
+    g = jax.grad(lambda a: jnp.sum(V.yolo_loss(
+        a, gtb, gtl, anchors, [0, 1, 2], 4, 0.7, 8)))(x)
+    assert bool(jnp.any(g != 0))
+
+
+def test_class_center_sample():
+    paddle_tpu.seed(0)
+    lbl = jnp.asarray([3, 7, 3, 1])
+    remap, sampled = F.class_center_sample(lbl, 20, 6)
+    sampled, remap = np.asarray(sampled), np.asarray(remap)
+    assert len(sampled) == 6 and set([1, 3, 7]) <= set(sampled.tolist())
+    np.testing.assert_array_equal(sampled[remap], np.asarray(lbl))
+    assert (np.diff(sampled) > 0).all()
+    # more positives than samples: all positives kept
+    remap2, sampled2 = F.class_center_sample(jnp.arange(8), 20, 4)
+    np.testing.assert_array_equal(np.asarray(sampled2), np.arange(8))
+
+
+def test_sparse_attention_matches_masked_dense():
+    import paddle_tpu.sparse as sp
+    import paddle_tpu.sparse.nn as spnn
+
+    B, H, L, D = 2, 2, 6, 4
+    q = rs.randn(B, H, L, D).astype(np.float32)
+    k = rs.randn(B, H, L, D).astype(np.float32)
+    v = rs.randn(B, H, L, D).astype(np.float32)
+    dm = (rs.rand(L, L) > 0.4) | np.eye(L, dtype=bool)
+    idx = np.nonzero(dm)
+    pattern = sp.sparse_coo_tensor(np.stack(idx),
+                                   np.ones(len(idx[0]), np.float32), (L, L))
+    out = spnn.attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         pattern)
+    scores = np.einsum("bhld,bhmd->bhlm", q, k) / np.sqrt(D)
+    scores = np.where(dm[None, None], scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhlm,bhmd->bhld", p, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_conv3d_against_dense_torch():
+    import paddle_tpu.sparse.nn as spnn
+    from jax.experimental import sparse as jsparse
+
+    dense = rs.randn(1, 5, 5, 5, 3).astype(np.float32)
+    dense *= (rs.rand(1, 5, 5, 5) > 0.7)[..., None]
+    x = jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+    wt = rs.randn(3, 3, 3, 3, 4).astype(np.float32)
+    ref = torch.nn.functional.conv3d(
+        torch.tensor(dense.transpose(0, 4, 1, 2, 3)),
+        torch.tensor(wt.transpose(4, 3, 0, 1, 2)), padding=1
+    ).numpy().transpose(0, 2, 3, 4, 1)
+    ours = np.asarray(spnn.conv3d(x, jnp.asarray(wt), padding=1).todense())
+    sup = np.abs(ours).sum(-1) > 0
+    np.testing.assert_allclose(ours[sup], ref[sup], rtol=1e-4, atol=1e-5)
+    # stride 2 matches on support too
+    o2 = np.asarray(spnn.conv3d(x, jnp.asarray(wt), stride=2,
+                                padding=1).todense())
+    r2 = torch.nn.functional.conv3d(
+        torch.tensor(dense.transpose(0, 4, 1, 2, 3)),
+        torch.tensor(wt.transpose(4, 3, 0, 1, 2)), stride=2, padding=1
+    ).numpy().transpose(0, 2, 3, 4, 1)
+    s2 = np.abs(o2).sum(-1) > 0
+    np.testing.assert_allclose(o2[s2], r2[s2], rtol=1e-4, atol=1e-5)
+    # subm: pattern preserved, values match dense conv at active sites
+    osub = spnn.subm_conv3d(x, jnp.asarray(wt), padding=1)
+    od = np.asarray(osub.todense())
+    pat_in = np.abs(dense).sum(-1) > 0
+    assert ((np.abs(od).sum(-1) > 0) <= pat_in).all()
+    act = pat_in & (np.abs(od).sum(-1) > 0)
+    np.testing.assert_allclose(od[act], ref[act], rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError):
+        spnn.subm_conv3d(x, jnp.asarray(wt), stride=2)
+
+
+def test_matrix_exp_and_corrcoef():
+    from paddle_tpu.tensor import linalg as L2
+
+    a = rs.randn(4, 4).astype(np.float32) * 0.3
+    np.testing.assert_allclose(
+        np.asarray(L2.matrix_exp(jnp.asarray(a))),
+        torch.matrix_exp(torch.tensor(a)).numpy(), rtol=1e-4, atol=1e-5)
+    x = rs.randn(3, 10).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(L2.corrcoef(jnp.asarray(x))), np.corrcoef(x),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_fused_epilogue_and_varlen_attention():
+    from paddle_tpu import ops
+
+    x = jnp.asarray(rs.randn(2, 5, 8).astype(np.float32))
+    res = jnp.asarray(rs.randn(2, 5, 8).astype(np.float32))
+    b = jnp.asarray(rs.randn(8).astype(np.float32))
+    g = jnp.asarray(rs.rand(8).astype(np.float32) + 0.5)
+    got = ops.fused_bias_dropout_residual_layer_norm(
+        x, res, b, g, None, dropout_rate=0.0)
+    want = F.layer_norm(res + x + b, [8], g, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+    B, H, S, D = 2, 2, 8, 16
+    q = rs.randn(B, H, S, D).astype(np.float32)
+    k = rs.randn(B, H, S, D).astype(np.float32)
+    v = rs.randn(B, H, S, D).astype(np.float32)
+    lens = np.array([5, 8])
+    kvlens = np.array([6, 8])
+    out = ops.variable_length_memory_efficient_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(lens), jnp.asarray(kvlens))
+    sc = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(D)
+    maskk = np.arange(S)[None, :] < kvlens[:, None]
+    sc = np.where(maskk[:, None, None, :], sc, -1e30)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhst,bhtd->bhsd", p, v)
+    maskq = np.arange(S)[None, :] < lens[:, None]
+    ref = np.where(maskq[:, None, :, None], ref, 0.0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-4)
