@@ -142,9 +142,6 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "ring_attention", "ssd_scan", "wkv",
         "fused_bias_dropout_residual_layer_norm",
         "variable_length_memory_efficient_attention",
-        # work queue (absent): whole-block inference fusion — implement as
-        # a composition when a serving config needs it; Pallas only where
-        # XLA's fusion provably loses (the rms_norm lesson, BENCH_OPS.json)
         "fused_multi_transformer",
     ],
     "paddle.distributed": [

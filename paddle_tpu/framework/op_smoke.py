@@ -252,6 +252,7 @@ def smoke_cases() -> Dict[str, Callable[[], Any]]:
         "flash_attention": lambda f: f(q, q, q, causal=True),
         "fused_bias_dropout_residual_layer_norm": lambda f: f(
             x, y, dropout_rate=0.0),
+        "fused_multi_transformer": lambda f: _fmt_case(f),
         "variable_length_memory_efficient_attention": lambda f: f(
             jnp.swapaxes(q, 1, 2), jnp.swapaxes(q, 1, 2),
             jnp.swapaxes(q, 1, 2), jnp.asarray([6]), jnp.asarray([8])),
@@ -526,6 +527,17 @@ def _istft_case(f):
     # compiled program (see the chip-quirk note at the "istft" case)
     return jax.jit(lambda s: f(stft(s, 16), 16))(
         jnp.ones((64,), jnp.float32))
+
+
+def _fmt_case(f):
+    e, nh, hd, ff = 8, 2, 4, 16
+    ones = jnp.ones
+    return f(ones((1, 4, e)), [ones(e)], [ones(e) * 0.0],
+             [ones((3, nh, hd, e)) * 0.1], [ones((3, nh, hd)) * 0.0],
+             [ones((nh * hd, e)) * 0.1], [ones(e) * 0.0],
+             [ones(e)], [ones(e) * 0.0],
+             [ones((e, ff)) * 0.1], [ones(ff) * 0.0],
+             [ones((ff, e)) * 0.1], [ones(e) * 0.0])
 
 
 def _sq_coo():

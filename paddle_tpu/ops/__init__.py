@@ -13,6 +13,7 @@ from .attention import flash_attention, flash_attention_reference
 from .norms import rms_norm, rms_norm_reference
 from .rope import apply_rope, build_rope_cache, fused_rope
 from .fused import (fused_bias_dropout_residual_layer_norm,
+                    fused_multi_transformer,
                     variable_length_memory_efficient_attention)
 
 __all__ = [
@@ -20,5 +21,6 @@ __all__ = [
     "rms_norm", "rms_norm_reference",
     "apply_rope", "build_rope_cache", "fused_rope",
     "fused_bias_dropout_residual_layer_norm",
+    "fused_multi_transformer",
     "variable_length_memory_efficient_attention",
 ]

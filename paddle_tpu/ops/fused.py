@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["fused_bias_dropout_residual_layer_norm",
-           "variable_length_memory_efficient_attention"]
+           "variable_length_memory_efficient_attention",
+           "fused_multi_transformer"]
 
 
 def fused_bias_dropout_residual_layer_norm(
@@ -75,3 +76,107 @@ def variable_length_memory_efficient_attention(
                                                              None],
                     out, 0.0)
     return jnp.swapaxes(out, 1, 2)
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+        linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
+        ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
+        pre_layer_norm: bool = True, epsilon: float = 1e-5,
+        cache_kvs=None, time_step=None, attn_mask=None,
+        activation: str = "gelu", dropout_rate: float = 0.0,
+        training: bool = False):
+    """Whole decoder stack in one call (parity: paddle.incubate.nn.
+    functional.fused_multi_transformer — the reference's single fused
+    inference kernel for serving stacks).
+
+    Composition by design: each layer is pre-LN → QKV → causal attention
+    (flash kernel when eligible; cached math path at decode) → out proj →
+    residual → FFN, and XLA fuses the chain — the measured stance of
+    BENCH_OPS.json.  Per-layer params arrive as lists, paddle's layout:
+    ``qkv_weights[i]``: (3, num_head, head_dim, embed_dim);
+    ``linear_weights[i]``: (num_head·head_dim, embed_dim);
+    ``ffn1_weights[i]``: (embed_dim, ffn_dim); ``ffn2_weights[i]``:
+    (ffn_dim, embed_dim).
+
+    ``cache_kvs``: optional list of (2, B, num_head, max_len, head_dim)
+    arrays; with ``time_step`` (an int: tokens already cached) the call is
+    one decode step over the cache.  Returns ``out`` or
+    ``(out, cache_kvs)`` when caches are passed — the reference's
+    convention.
+    """
+    from ..nn import functional as F
+    from .attention import flash_attention, flash_attention_reference
+
+    act = {"gelu": F.gelu, "relu": F.relu}[activation]
+    b, s, _ = x.shape
+    n_layers = len(qkv_weights)
+    new_caches = [] if cache_kvs is not None else None
+    pos = 0 if time_step is None else time_step
+
+    def ln(v, scales, biases, i):
+        return F.layer_norm(v, [v.shape[-1]], scales[i],
+                            biases[i] if biases else None, epsilon=epsilon)
+
+    def drop(v):
+        return F.dropout(v, p=dropout_rate, training=training) \
+            if dropout_rate > 0.0 else v
+
+    out = x
+    for i in range(n_layers):
+        residual = out
+        h = ln(out, ln_scales, ln_biases, i) if pre_layer_norm else out
+        wq = qkv_weights[i]                 # (3, nh, hd, E)
+        _, nh, hd, e = wq.shape
+        qkv = jnp.einsum("bse,cnhe->cbsnh", h, wq)     # (3, B, S, nh, hd)
+        if qkv_biases and qkv_biases[i] is not None:
+            qkv = qkv + qkv_biases[i].reshape(3, 1, 1, nh, hd)
+        q, k, v = qkv[0], qkv[1], qkv[2]               # (B, S, nh, hd)
+
+        if cache_kvs is not None:
+            cache = cache_kvs[i]                       # (2, B, nh, L, hd)
+            k_c = jax.lax.dynamic_update_slice(
+                cache[0], jnp.swapaxes(k, 1, 2).astype(cache.dtype),
+                (0, 0, pos, 0))
+            v_c = jax.lax.dynamic_update_slice(
+                cache[1], jnp.swapaxes(v, 1, 2).astype(cache.dtype),
+                (0, 0, pos, 0))
+            new_caches.append(jnp.stack([k_c, v_c]))
+            from ..models.generation import cache_mask
+            mask = cache_mask(pos, s, k_c.shape[2])
+            if attn_mask is not None:  # e.g. padding mask: composes with
+                mask = (mask & attn_mask if attn_mask.dtype == jnp.bool_
+                        else jnp.where(mask, attn_mask,
+                                       jnp.float32(-1e30)))
+            attn = flash_attention_reference(
+                q, jnp.swapaxes(k_c, 1, 2), jnp.swapaxes(v_c, 1, 2),
+                attn_mask=mask, return_lse=False)
+        elif attn_mask is not None:
+            attn = flash_attention_reference(q, k, v, attn_mask=attn_mask,
+                                             return_lse=False)
+        else:
+            attn = flash_attention(q, k, v, causal=True)
+        proj = attn.reshape(b, s, nh * hd) @ linear_weights[i]
+        if linear_biases and linear_biases[i] is not None:
+            proj = proj + linear_biases[i]
+        out = residual + drop(proj)
+        if not pre_layer_norm:             # post-LN: normalise AFTER the add
+            out = ln(out, ln_scales, ln_biases, i)
+
+        residual = out
+        h = (ln(out, ffn_ln_scales, ffn_ln_biases, i) if pre_layer_norm
+             else out)
+        h = h @ ffn1_weights[i]
+        if ffn1_biases and ffn1_biases[i] is not None:
+            h = h + ffn1_biases[i]
+        h = act(h)
+        h = h @ ffn2_weights[i]
+        if ffn2_biases and ffn2_biases[i] is not None:
+            h = h + ffn2_biases[i]
+        out = residual + drop(h)
+        if not pre_layer_norm:
+            out = ln(out, ffn_ln_scales, ffn_ln_biases, i)
+
+    if cache_kvs is not None:
+        return out, new_caches
+    return out

@@ -937,3 +937,73 @@ def test_fused_epilogue_and_varlen_attention():
     maskq = np.arange(S)[None, :] < lens[:, None]
     ref = np.where(maskq[:, None, :, None], ref, 0.0)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_fused_multi_transformer_against_torch_stack():
+    from paddle_tpu import ops
+
+    B, S, NH, HD, E, FF, L = 2, 8, 2, 16, 32, 64, 2
+    x = rs.randn(B, S, E).astype(np.float32)
+    P = []
+    for _ in range(L):
+        P.append(dict(
+            ln_s=rs.rand(E).astype(np.float32) + 0.5,
+            ln_b=rs.randn(E).astype(np.float32),
+            qkv=rs.randn(3, NH, HD, E).astype(np.float32) * 0.1,
+            qkv_b=rs.randn(3, NH, HD).astype(np.float32) * 0.1,
+            lin=rs.randn(NH * HD, E).astype(np.float32) * 0.1,
+            lin_b=rs.randn(E).astype(np.float32) * 0.1,
+            fln_s=rs.rand(E).astype(np.float32) + 0.5,
+            fln_b=rs.randn(E).astype(np.float32),
+            f1=rs.randn(E, FF).astype(np.float32) * 0.1,
+            f1_b=rs.randn(FF).astype(np.float32) * 0.1,
+            f2=rs.randn(FF, E).astype(np.float32) * 0.1,
+            f2_b=rs.randn(E).astype(np.float32) * 0.1))
+
+    def torch_ref(xt):
+        out = torch.tensor(xt)
+        slen = xt.shape[1]
+        for p in P:
+            res = out
+            h = torch.nn.functional.layer_norm(
+                out, (E,), torch.tensor(p["ln_s"]), torch.tensor(p["ln_b"]))
+            qkv = torch.einsum("bse,cnhe->cbsnh", h, torch.tensor(p["qkv"])
+                               ) + torch.tensor(p["qkv_b"]).reshape(
+                3, 1, 1, NH, HD)
+            q, k, v = (t.permute(0, 2, 1, 3) for t in (qkv[0], qkv[1],
+                                                       qkv[2]))
+            a = torch.nn.functional.scaled_dot_product_attention(
+                q, k, v, is_causal=True)
+            a = a.permute(0, 2, 1, 3).reshape(xt.shape[0], slen, NH * HD)
+            out = res + a @ torch.tensor(p["lin"]) + torch.tensor(p["lin_b"])
+            res = out
+            h = torch.nn.functional.layer_norm(
+                out, (E,), torch.tensor(p["fln_s"]),
+                torch.tensor(p["fln_b"]))
+            h = torch.nn.functional.gelu(
+                h @ torch.tensor(p["f1"]) + torch.tensor(p["f1_b"]))
+            out = res + h @ torch.tensor(p["f2"]) + torch.tensor(p["f2_b"])
+        return out.numpy()
+
+    J = jnp.asarray
+    args = ([J(p["ln_s"]) for p in P], [J(p["ln_b"]) for p in P],
+            [J(p["qkv"]) for p in P], [J(p["qkv_b"]) for p in P],
+            [J(p["lin"]) for p in P], [J(p["lin_b"]) for p in P],
+            [J(p["fln_s"]) for p in P], [J(p["fln_b"]) for p in P],
+            [J(p["f1"]) for p in P], [J(p["f1_b"]) for p in P],
+            [J(p["f2"]) for p in P], [J(p["f2_b"]) for p in P])
+    got = ops.fused_multi_transformer(J(x), *args)
+    np.testing.assert_allclose(np.asarray(got), torch_ref(x), rtol=1e-4,
+                               atol=1e-5)
+    # cached prefill + one decode step == full forward's last position
+    caches = [jnp.zeros((2, B, NH, 12, HD)) for _ in range(L)]
+    out_pre, caches = ops.fused_multi_transformer(
+        J(x), *args, cache_kvs=caches, time_step=0)
+    np.testing.assert_allclose(np.asarray(out_pre), torch_ref(x),
+                               rtol=1e-4, atol=1e-4)
+    x1 = rs.randn(B, 1, E).astype(np.float32)
+    out_step, _ = ops.fused_multi_transformer(
+        J(x1), *args, cache_kvs=caches, time_step=S)
+    full = torch_ref(np.concatenate([x, x1], axis=1))
+    np.testing.assert_allclose(np.asarray(out_step)[:, 0], full[:, -1],
+                               rtol=1e-3, atol=1e-4)

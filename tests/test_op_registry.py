@@ -22,7 +22,7 @@ FLOOR = {
     "paddle.random": 15,
     "paddle.linalg": 28,
     "paddle.nn.functional": 100,
-    "paddle.incubate": 8,
+    "paddle.incubate": 9,
     "paddle.distributed": 13,
     "paddle.optimizer": 9,
     "paddle.optimizer.lr": 9,
@@ -35,12 +35,14 @@ FLOOR = {
 }
 
 # Ceiling on the absent-name work queue (24 at the round-4 open → 10 → 6
-# → 4: 3 tape-semantics Tensor methods + fused_multi_transformer).  The
-# queue is deliberately non-empty — it is the visible backlog toward the
+# → 3: the tape-semantics Tensor methods backward/register_hook/
+# pin_memory, design-absent because functional jax has no eager autograd
+# tape or pinned-host placement to hang them on).  The queue is
+# deliberately non-empty — it is the visible backlog toward the
 # reference's ~1900-entry op YAML — but it must only shrink; growing the
 # target without implementing is caught here and requires raising this
 # consciously.
-ABSENT_CEILING = 4
+ABSENT_CEILING = 3
 
 
 def test_registry_counts_do_not_regress(capsys):
