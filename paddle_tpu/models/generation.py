@@ -41,13 +41,9 @@ def init_kv_cache(config, batch_size: int, max_length: int, dtype=None):
                       config.num_key_value_heads, config.head_dim), dt)
 
 
-def cache_mask(pos, q_len: int, kv_len: int):
-    """Bool (1, 1, q_len, kv_len) mask: query i (global position pos+i) may
-    attend to cache slot j iff j <= pos+i (causal + don't read the
-    uninitialised tail)."""
-    qi = pos + jnp.arange(q_len)[:, None]
-    kj = jnp.arange(kv_len)[None, :]
-    return (kj <= qi)[None, None]
+# canonical home is the ops layer (models depend on ops, never the
+# reverse); re-exported here for the existing call sites
+from ..ops.attention import cache_mask  # noqa: E402,F401
 
 
 def _place_on_mesh(model, params, cache, input_ids):

@@ -113,6 +113,15 @@ def flash_attention_reference(q, k, v, attn_mask=None, dropout_p: float = 0.0,
     return out
 
 
+def cache_mask(pos, q_len: int, kv_len: int):
+    """Bool (1, 1, q_len, kv_len) mask for attention over a pre-allocated
+    KV cache: query i (global position pos+i) may attend cache slot j iff
+    j <= pos+i (causal + don't read the uninitialised tail)."""
+    qi = pos + jnp.arange(q_len)[:, None]
+    kj = jnp.arange(kv_len)[None, :]
+    return (kj <= qi)[None, None]
+
+
 def segment_mask(q_segment_ids, kv_segment_ids):
     """Packed-sequence (varlen) mask: query i may attend key j iff they
     belong to the same packed document (parity: the reference's

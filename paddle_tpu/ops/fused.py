@@ -106,7 +106,8 @@ def fused_multi_transformer(
     convention.
     """
     from ..nn import functional as F
-    from .attention import flash_attention, flash_attention_reference
+    from .attention import (NEG_INF, cache_mask, flash_attention,
+                            flash_attention_reference)
 
     act = {"gelu": F.gelu, "relu": F.relu}[activation]
     b, s, _ = x.shape
@@ -142,20 +143,28 @@ def fused_multi_transformer(
                 cache[1], jnp.swapaxes(v, 1, 2).astype(cache.dtype),
                 (0, 0, pos, 0))
             new_caches.append(jnp.stack([k_c, v_c]))
-            from ..models.generation import cache_mask
-            mask = cache_mask(pos, s, k_c.shape[2])
-            if attn_mask is not None:  # e.g. padding mask: composes with
-                mask = (mask & attn_mask if attn_mask.dtype == jnp.bool_
-                        else jnp.where(mask, attn_mask,
-                                       jnp.float32(-1e30)))
-            attn = flash_attention_reference(
-                q, jnp.swapaxes(k_c, 1, 2), jnp.swapaxes(v_c, 1, 2),
-                attn_mask=mask, return_lse=False)
-        elif attn_mask is not None:
-            attn = flash_attention_reference(q, k, v, attn_mask=attn_mask,
-                                             return_lse=False)
+            if (isinstance(pos, int) and pos == 0 and s > 1
+                    and attn_mask is None):
+                # prefill: attention over the cache at pos 0 is exactly
+                # causal attention over the fresh K/V — take the flash
+                # kernel instead of an O(S·max_len) masked math pass
+                attn = flash_attention(q, k, v, causal=True)
+            else:
+                mask = cache_mask(pos, s, k_c.shape[2])
+                if attn_mask is not None:  # padding masks compose
+                    mask = (mask & attn_mask
+                            if attn_mask.dtype == jnp.bool_
+                            else jnp.where(mask, attn_mask,
+                                           jnp.float32(NEG_INF)))
+                attn = flash_attention_reference(
+                    q, jnp.swapaxes(k_c, 1, 2), jnp.swapaxes(v_c, 1, 2),
+                    attn_mask=mask, return_lse=False)
         else:
-            attn = flash_attention(q, k, v, causal=True)
+            # same semantics either way: causal, with an optional padding
+            # mask composed on top (never REPLACING causality — the two
+            # branches must agree for identical arguments)
+            attn = flash_attention(q, k, v, causal=True,
+                                   attn_mask=attn_mask)
         proj = attn.reshape(b, s, nh * hd) @ linear_weights[i]
         if linear_biases and linear_biases[i] is not None:
             proj = proj + linear_biases[i]

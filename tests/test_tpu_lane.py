@@ -223,3 +223,28 @@ def test_llama_decode_on_chip():
     out = lm.generate(ids, max_new_tokens=4)
     assert out.shape == (2, 10)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_prefill_flash_forced_on_chip():
+    """Cached prefill (static pos=0) must take the real Mosaic kernel on
+    the chip — flash_attention_force turns a silent fallback into an
+    error — and match the all-reference generation exactly."""
+    from paddle_tpu import flags
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+
+    cfg = tiny_llama_config(hidden_size=256, intermediate_size=256,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            max_position_embeddings=160)
+    pt.seed(31)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = jnp.asarray(np.random.default_rng(33).integers(
+        0, cfg.vocab_size, (2, 128)), jnp.int32)
+    ref = np.asarray(model.generate(ids, max_new_tokens=4))
+    model._generate_jit_cache.clear()
+    flags.set_flags({"flash_attention_force": True})
+    try:
+        out = np.asarray(model.generate(ids, max_new_tokens=4))
+    finally:
+        flags.set_flags({"flash_attention_force": False})
+    np.testing.assert_array_equal(ref, out)
