@@ -149,6 +149,35 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "gather", "irecv", "isend", "recv", "reduce", "reduce_scatter",
         "scatter", "send",
     ],
+    "paddle.nn": [
+        # the Layer-class surface users build models from (upstream:
+        # python/paddle/nn/layer/); resolved against paddle_tpu.nn
+        "Layer", "Sequential", "LayerList", "Linear", "Embedding",
+        "Dropout", "Identity", "Flatten", "Unflatten",
+        "Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+        "Conv3DTranspose",
+        "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+        "SyncBatchNorm", "InstanceNorm1D", "InstanceNorm2D", "LayerNorm",
+        "GroupNorm", "RMSNorm", "LocalResponseNorm",
+        "MaxPool1D", "MaxPool2D", "AvgPool1D", "AvgPool2D",
+        "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+        "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+        "ReLU", "ReLU6", "GELU", "SiLU", "Sigmoid", "Tanh", "Softmax",
+        "LogSoftmax", "LogSigmoid", "LeakyReLU", "PReLU", "ELU", "SELU",
+        "CELU", "GLU", "Hardshrink", "Hardsigmoid", "Hardswish",
+        "Hardtanh", "Maxout", "Mish", "Softplus", "Softshrink",
+        "Softsign", "Swish", "Tanhshrink", "ThresholdedReLU",
+        "SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell",
+        "MultiHeadAttention", "TransformerEncoderLayer",
+        "TransformerEncoder",
+        "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
+        "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "CTCLoss",
+        "MarginRankingLoss", "TripletMarginLoss", "CosineEmbeddingLoss",
+        "Pad2D", "ZeroPad2D", "Upsample", "UpsamplingBilinear2D",
+        "UpsamplingNearest2D", "PixelShuffle", "PixelUnshuffle",
+        "ChannelShuffle", "Unfold", "Fold", "CosineSimilarity",
+        "Dropout2D", "Dropout3D", "AlphaDropout",
+    ],
     "paddle.optimizer": [
         "Adagrad", "Adam", "AdamW", "Adamax", "Lamb", "Momentum",
         "Optimizer", "RMSProp", "SGD",
@@ -222,6 +251,7 @@ _IMPL_MODULES: Dict[str, List[str]] = {
     "paddle.nn.functional": ["paddle_tpu.nn.functional"],
     "paddle.incubate": ["paddle_tpu.ops"],
     "paddle.distributed": ["paddle_tpu.distributed.collective"],
+    "paddle.nn": ["paddle_tpu.nn"],
     "paddle.optimizer": ["paddle_tpu.optimizer"],
     "paddle.optimizer.lr": ["paddle_tpu.optimizer.lr"],
     "paddle.fft": ["paddle_tpu.tensor.fft"],

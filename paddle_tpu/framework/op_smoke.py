@@ -562,6 +562,124 @@ def _scaled_coo():
         jnp.asarray([[0, 1], [1, 2]]), jnp.asarray([0.3, 0.6]), (2, 3))
 
 
+def _nn_layer_thunk(name: str):
+    """paddle.nn Layer-class smokes: construct + one tiny forward."""
+
+    def thunk():
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        pt.seed(0)
+        x = jnp.ones((2, 8), jnp.float32)
+        img = jnp.ones((1, 4, 6, 6), jnp.float32)
+        sig = jnp.ones((1, 4, 8), jnp.float32)
+        vol = jnp.ones((1, 2, 4, 4, 4), jnp.float32)
+        seq = jnp.ones((2, 5, 8), jnp.float32)
+        ids1 = jnp.asarray([0, 1], jnp.int32)
+        logp = jax.nn.log_softmax(jnp.ones((2, 8)), axis=-1)
+
+        def loss2(cls, *a, **k):
+            return lambda: cls(*a, **k)(x, jnp.ones((2, 8)))
+
+        cases = {
+            "Layer": lambda: nn.Layer(),
+            "Sequential": lambda: nn.Sequential(nn.Linear(8, 4))(x),
+            "LayerList": lambda: nn.LayerList([nn.Linear(8, 4)]),
+            "Linear": lambda: nn.Linear(8, 4)(x),
+            "Embedding": lambda: nn.Embedding(10, 4)(ids1),
+            "Dropout": lambda: nn.Dropout(0.5)(x),
+            "Identity": lambda: nn.Identity()(x),
+            "Flatten": lambda: nn.Flatten()(img),
+            "Unflatten": lambda: nn.Unflatten(1, [2, 4])(x),
+            "Conv1D": lambda: nn.Conv1D(4, 3, 2)(sig),
+            "Conv2D": lambda: nn.Conv2D(4, 3, 2)(img),
+            "Conv3D": lambda: nn.Conv3D(2, 3, 2)(vol),
+            "Conv1DTranspose": lambda: nn.Conv1DTranspose(4, 3, 2)(sig),
+            "Conv2DTranspose": lambda: nn.Conv2DTranspose(4, 3, 2)(img),
+            "Conv3DTranspose": lambda: nn.Conv3DTranspose(2, 3, 2)(vol),
+            "BatchNorm": lambda: nn.BatchNorm(4)(img),
+            "BatchNorm1D": lambda: nn.BatchNorm1D(4)(sig),
+            "BatchNorm2D": lambda: nn.BatchNorm2D(4)(img),
+            "BatchNorm3D": lambda: nn.BatchNorm3D(2)(vol),
+            "SyncBatchNorm": lambda: nn.SyncBatchNorm(4)(img),
+            "InstanceNorm1D": lambda: nn.InstanceNorm1D(4)(sig),
+            "InstanceNorm2D": lambda: nn.InstanceNorm2D(4)(img),
+            "LayerNorm": lambda: nn.LayerNorm([8])(x),
+            "GroupNorm": lambda: nn.GroupNorm(2, 4)(img),
+            "RMSNorm": lambda: nn.RMSNorm(8)(x),
+            "LocalResponseNorm": lambda: nn.LocalResponseNorm(3)(img),
+            "MaxPool1D": lambda: nn.MaxPool1D(2)(sig),
+            "MaxPool2D": lambda: nn.MaxPool2D(2)(img),
+            "AvgPool1D": lambda: nn.AvgPool1D(2)(sig),
+            "AvgPool2D": lambda: nn.AvgPool2D(2)(img),
+            "AdaptiveAvgPool1D": lambda: nn.AdaptiveAvgPool1D(2)(sig),
+            "AdaptiveAvgPool2D": lambda: nn.AdaptiveAvgPool2D(2)(img),
+            "AdaptiveAvgPool3D": lambda: nn.AdaptiveAvgPool3D(2)(vol),
+            "AdaptiveMaxPool1D": lambda: nn.AdaptiveMaxPool1D(2)(sig),
+            "AdaptiveMaxPool2D": lambda: nn.AdaptiveMaxPool2D(2)(img),
+            "PReLU": lambda: nn.PReLU()(x),
+            "Maxout": lambda: nn.Maxout(2)(img),
+            "GLU": lambda: nn.GLU()(x),
+            "SimpleRNN": lambda: nn.SimpleRNN(8, 6)(seq),
+            "LSTM": lambda: nn.LSTM(8, 6)(seq),
+            "GRU": lambda: nn.GRU(8, 6, direction="bidirect")(seq),
+            "SimpleRNNCell": lambda: nn.SimpleRNNCell(8, 6)(x),
+            "LSTMCell": lambda: nn.LSTMCell(8, 6)(x),
+            "GRUCell": lambda: nn.GRUCell(8, 6)(x),
+            "MultiHeadAttention":
+                lambda: nn.MultiHeadAttention(8, 2)(seq, seq, seq),
+            "TransformerEncoderLayer":
+                lambda: nn.TransformerEncoderLayer(8, 2, 16)(seq),
+            "TransformerEncoder": lambda: nn.TransformerEncoder(
+                lambda: nn.TransformerEncoderLayer(8, 2, 16), 2)(seq),
+            "CrossEntropyLoss": lambda: nn.CrossEntropyLoss()(
+                x, jnp.asarray([1, 2])),
+            "NLLLoss": lambda: nn.NLLLoss()(logp, jnp.asarray([1, 2])),
+            "BCELoss": lambda: nn.BCELoss()(
+                jax.nn.sigmoid(x), jnp.ones((2, 8))),
+            "CTCLoss": lambda: nn.CTCLoss()(
+                jax.nn.log_softmax(jnp.ones((6, 2, 5)), axis=-1),
+                jnp.asarray([[1, 2], [3, 4]]), jnp.asarray([6, 6]),
+                jnp.asarray([2, 2])),
+            "MarginRankingLoss": lambda: nn.MarginRankingLoss()(
+                x, x + 0.1, jnp.sign(x)),
+            "TripletMarginLoss": lambda: nn.TripletMarginLoss()(
+                x, x + 0.1, x - 1.0),
+            "CosineEmbeddingLoss": lambda: nn.CosineEmbeddingLoss()(
+                x, x + 0.1, jnp.ones((2,))),
+            "Pad2D": lambda: nn.Pad2D([1, 1, 1, 1])(img),
+            "ZeroPad2D": lambda: nn.ZeroPad2D([1, 1, 1, 1])(img),
+            "Upsample": lambda: nn.Upsample(scale_factor=2)(img),
+            "UpsamplingBilinear2D":
+                lambda: nn.UpsamplingBilinear2D(scale_factor=2)(img),
+            "UpsamplingNearest2D":
+                lambda: nn.UpsamplingNearest2D(scale_factor=2)(img),
+            "PixelShuffle": lambda: nn.PixelShuffle(2)(img),
+            "PixelUnshuffle": lambda: nn.PixelUnshuffle(2)(img),
+            "ChannelShuffle": lambda: nn.ChannelShuffle(2)(img),
+            "Unfold": lambda: nn.Unfold(2)(img),
+            "Fold": lambda: nn.Fold((6, 6), 2, strides=2)(
+                jnp.ones((1, 16, 9))),
+            "CosineSimilarity": lambda: nn.CosineSimilarity()(x, x + 1.0),
+            "Dropout2D": lambda: nn.Dropout2D()(img),
+            "Dropout3D": lambda: nn.Dropout3D()(vol),
+            "AlphaDropout": lambda: nn.AlphaDropout()(x),
+        }
+        if name in cases:
+            out = cases[name]()
+        else:
+            # activation / simple loss layers: ctor() then forward(x)
+            cls = getattr(nn, name)
+            inst = cls()
+            out = (inst(x, jnp.ones((2, 8)))
+                   if name.endswith("Loss") else inst(x))
+        for leaf in jax.tree_util.tree_leaves(out):
+            if isinstance(leaf, jax.Array):
+                jax.block_until_ready(leaf)
+        return out
+    return thunk
+
+
 def _tensor_method_thunk_checked(name: str):
     inner = _tensor_method_thunk(name)
 
@@ -681,6 +799,8 @@ class Absent(Exception):
 def _make_thunk(cat: str, name: str, special, x, y, unit, pos, idx):
     if cat == "paddle.Tensor":
         return _tensor_method_thunk_checked(name)
+    if cat == "paddle.nn":
+        return _nn_layer_thunk(name)
 
     def thunk():
         table = op_registry.resolve()[cat]

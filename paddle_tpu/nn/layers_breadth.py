@@ -1,0 +1,566 @@
+"""Round-4 breadth of the ``paddle.nn`` Layer-class surface.
+
+Thin Layer wrappers over :mod:`paddle_tpu.nn.functional` (upstream parity:
+python/paddle/nn/layer/{norm,conv,pooling,activation,loss,common}.py) —
+the class surface reference users build models from.  BatchNorm/
+InstanceNorm carry running-stat buffers under paddle's ``_mean`` /
+``_variance`` names; in eager training mode the buffers update in place,
+under ``functional_call``/jit the traced updates are discarded (batch
+stats are used for normalisation either way — the caveat is on the
+*running* stats, documented on the class).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = [
+    # norms
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+    "InstanceNorm1D", "InstanceNorm2D", "SyncBatchNorm", "LocalResponseNorm",
+    # conv
+    "Conv1D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+    "Conv3DTranspose",
+    # pool
+    "MaxPool1D", "AvgPool1D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+    # activations
+    "LeakyReLU", "PReLU", "ELU", "SELU", "CELU", "GLU", "Hardshrink",
+    "Hardsigmoid", "Hardswish", "Hardtanh", "LogSigmoid", "LogSoftmax",
+    "Maxout", "Mish", "ReLU6", "Softplus", "Softshrink", "Softsign",
+    "Swish", "Tanhshrink", "ThresholdedReLU",
+    # losses
+    "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
+    "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "CTCLoss",
+    "MarginRankingLoss", "TripletMarginLoss", "CosineEmbeddingLoss",
+    # shape / vision
+    "Flatten", "Unflatten", "Pad2D", "ZeroPad2D", "Upsample",
+    "UpsamplingBilinear2D", "UpsamplingNearest2D", "PixelShuffle",
+    "PixelUnshuffle", "ChannelShuffle", "Unfold", "Fold", "CosineSimilarity",
+    "Dropout2D", "Dropout3D", "AlphaDropout",
+]
+
+
+# ---------------------------------------------------------------------------
+# norms with running-stat buffers
+# ---------------------------------------------------------------------------
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel axis (paddle buffer names ``_mean`` /
+    ``_variance``).  Eager training updates the running stats in place;
+    under jit the traced update is discarded (batch stats still
+    normalise) — thread stats functionally if you jit a training loop."""
+
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, data_format: str = "NCHW",
+                 dtype=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_features,), dtype=dtype, initializer=I.Constant(1.0),
+            attr_name="weight")
+        self.bias = self.create_parameter(
+            (num_features,), dtype=dtype, initializer=I.Constant(0.0),
+            attr_name="bias")
+        self.register_buffer("_mean", jnp.zeros((num_features,)))
+        self.register_buffer("_variance", jnp.ones((num_features,)))
+
+    def forward(self, x):
+        if self.training:
+            ch_axis = 1 if self.data_format.startswith("NC") else -1
+            axes = tuple(i for i in range(x.ndim)
+                         if i != ch_axis % x.ndim)
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            m = self.momentum
+            try:  # eager: update running stats; traced: silently dropped
+                object.__setattr__(self, "_mean",
+                                   m * self._mean + (1 - m) * mean)
+                object.__setattr__(self, "_variance",
+                                   m * self._variance + (1 - m) * var)
+            except Exception:
+                pass
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self.momentum, epsilon=self.epsilon,
+                            data_format=self.data_format)
+
+
+class BatchNorm1D(BatchNorm):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NCL", dtype=None):
+        super().__init__(num_features, momentum, epsilon,
+                         "NCHW" if data_format == "NCL" else "NHWC", dtype)
+
+
+class BatchNorm2D(BatchNorm):
+    pass
+
+
+class BatchNorm3D(BatchNorm):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NCDHW", dtype=None):
+        super().__init__(num_features, momentum, epsilon,
+                         "NCHW" if data_format == "NCDHW" else "NHWC",
+                         dtype)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Parity alias: under GSPMD the batch axis is already global, so
+    plain BatchNorm statistics ARE the synced statistics — the reference's
+    cross-replica allreduce comes free from sharding propagation."""
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features: int, epsilon: float = 1e-5,
+                 data_format: str = "NCHW", dtype=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.scale = self.create_parameter(
+            (num_features,), dtype=dtype, initializer=I.Constant(1.0),
+            attr_name="scale")
+        self.bias = self.create_parameter(
+            (num_features,), dtype=dtype, initializer=I.Constant(0.0),
+            attr_name="bias")
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               epsilon=self.epsilon,
+                               data_format=self.data_format)
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size: int, alpha: float = 1e-4, beta: float = 0.75,
+                 k: float = 1.0, data_format: str = "NCHW"):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+# ---------------------------------------------------------------------------
+# conv (1d/3d + transposes)
+# ---------------------------------------------------------------------------
+
+class _ConvNd(Layer):
+    FN = None
+    ND = 2
+    TRANSPOSE = False
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias: bool = True, dtype=None, **extra):
+        super().__init__()
+        ks = ((kernel_size,) * self.ND if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self._extra = extra
+        if self.TRANSPOSE:
+            shape = (in_channels, out_channels // groups) + ks
+        else:
+            shape = (out_channels, in_channels // groups) + ks
+        self.weight = self.create_parameter(
+            shape, dtype=dtype, initializer=I.XavierNormal(),
+            attr_name="weight")
+        self.bias = (self.create_parameter(
+            (out_channels,), dtype=dtype, initializer=I.Constant(0.0),
+            attr_name="bias") if bias else None)
+
+    def forward(self, x):
+        fn = getattr(F, self.FN)
+        return fn(x, self.weight, bias=self.bias, stride=self.stride,
+                  padding=self.padding, dilation=self.dilation,
+                  groups=self.groups, **self._extra)
+
+
+class Conv1D(_ConvNd):
+    FN, ND = "conv1d", 1
+
+
+class Conv3D(_ConvNd):
+    FN, ND = "conv3d", 3
+
+
+class Conv1DTranspose(_ConvNd):
+    FN, ND, TRANSPOSE = "conv1d_transpose", 1, True
+
+
+class Conv2DTranspose(_ConvNd):
+    FN, ND, TRANSPOSE = "conv2d_transpose", 2, True
+
+
+class Conv3DTranspose(_ConvNd):
+    FN, ND, TRANSPOSE = "conv3d_transpose", 3, True
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+class _Pool(Layer):
+    FN = None
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+
+    def forward(self, x):
+        return getattr(F, self.FN)(x, self.kernel_size, self.stride,
+                                   self.padding)
+
+
+class MaxPool1D(_Pool):
+    FN = "max_pool1d"
+
+
+class AvgPool1D(_Pool):
+    FN = "avg_pool1d"
+
+
+class _AdaptivePool(Layer):
+    FN = None
+
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return getattr(F, self.FN)(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    FN = "adaptive_avg_pool1d"
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    FN = "adaptive_avg_pool2d"
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    FN = "adaptive_avg_pool3d"
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    FN = "adaptive_max_pool1d"
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    FN = "adaptive_max_pool2d"
+
+
+# ---------------------------------------------------------------------------
+# activations as layers
+# ---------------------------------------------------------------------------
+
+def _act_layer(name, fn_name, arg_names=(), defaults=()):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        vals = list(defaults)
+        for i, a in enumerate(args):
+            vals[i] = a
+        for k, v in kwargs.items():
+            vals[arg_names.index(k)] = v
+        self._args = tuple(vals)
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, *self._args)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu", ("negative_slope",),
+                       (0.01,))
+ELU = _act_layer("ELU", "elu", ("alpha",), (1.0,))
+SELU = _act_layer("SELU", "selu", ("scale", "alpha"),
+                  (1.0507009873554805, 1.6732632423543772))
+CELU = _act_layer("CELU", "celu", ("alpha",), (1.0,))
+GLU = _act_layer("GLU", "glu", ("axis",), (-1,))
+Hardshrink = _act_layer("Hardshrink", "hardshrink", ("threshold",), (0.5,))
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid", (), ())
+Hardswish = _act_layer("Hardswish", "hardswish", (), ())
+Hardtanh = _act_layer("Hardtanh", "hardtanh", ("min", "max"), (-1.0, 1.0))
+LogSigmoid = _act_layer("LogSigmoid", "log_sigmoid", (), ())
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax", ("axis",), (-1,))
+Maxout = _act_layer("Maxout", "maxout", ("groups", "axis"), (2, 1))
+Mish = _act_layer("Mish", "mish", (), ())
+ReLU6 = _act_layer("ReLU6", "relu6", (), ())
+Softplus = _act_layer("Softplus", "softplus", ("beta", "threshold"),
+                      (1.0, 20.0))
+Softshrink = _act_layer("Softshrink", "softshrink", ("threshold",), (0.5,))
+Softsign = _act_layer("Softsign", "softsign", (), ())
+Swish = _act_layer("Swish", "swish", (), ())
+Tanhshrink = _act_layer("Tanhshrink", "tanhshrink", (), ())
+ThresholdedReLU = _act_layer("ThresholdedReLU", "thresholded_relu",
+                             ("threshold", "value"), (1.0, 0.0))
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters: int = 1, init: float = 0.25,
+                 dtype=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), dtype=dtype, initializer=I.Constant(init),
+            attr_name="weight")
+
+    def forward(self, x):
+        w = self.weight
+        if w.shape[0] > 1:  # per-channel (axis 1, NCHW)
+            w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return F.prelu(x, w)
+
+
+# ---------------------------------------------------------------------------
+# losses as layers
+# ---------------------------------------------------------------------------
+
+def _loss_layer(name, fn_name, kw=()):
+    def __init__(self, reduction: str = "mean", **kwargs):
+        Layer.__init__(self)
+        self.reduction = reduction
+        self._kw = {k: kwargs[k] for k in kw if k in kwargs}
+
+    def forward(self, input, label, *extra):
+        return getattr(F, fn_name)(input, label, *extra,
+                                   reduction=self.reduction, **self._kw)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+MSELoss = _loss_layer("MSELoss", "mse_loss")
+L1Loss = _loss_layer("L1Loss", "l1_loss")
+BCELoss = _loss_layer("BCELoss", "binary_cross_entropy")
+BCEWithLogitsLoss = _loss_layer("BCEWithLogitsLoss",
+                                "binary_cross_entropy_with_logits")
+KLDivLoss = _loss_layer("KLDivLoss", "kl_div")
+SmoothL1Loss = _loss_layer("SmoothL1Loss", "smooth_l1_loss", ("delta",))
+MarginRankingLoss = _loss_layer("MarginRankingLoss", "margin_ranking_loss",
+                                ("margin",))
+TripletMarginLoss = _loss_layer("TripletMarginLoss", "triplet_margin_loss",
+                                ("margin", "p", "epsilon", "swap"))
+CosineEmbeddingLoss = _loss_layer("CosineEmbeddingLoss",
+                                  "cosine_embedding_loss", ("margin",))
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean", soft_label: bool = False,
+                 label_smoothing: float = 0.0, axis: int = -1):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.label_smoothing = label_smoothing
+        self.axis = axis
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label,
+                               ignore_index=self.ignore_index,
+                               reduction=self.reduction,
+                               label_smoothing=self.label_smoothing,
+                               soft_label=self.soft_label, axis=self.axis)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean"):
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, weight=self.weight,
+                          ignore_index=self.ignore_index,
+                          reduction=self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+# ---------------------------------------------------------------------------
+# shape / vision layers
+# ---------------------------------------------------------------------------
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        start = self.start_axis % x.ndim
+        stop = self.stop_axis % x.ndim
+        shape = (x.shape[:start] + (-1,) + x.shape[stop + 1:])
+        return jnp.reshape(x, shape)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis: int, shape: Sequence[int]):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ..tensor.manipulation import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format: str = "NCHW"):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode: str = "nearest",
+                 align_corners: bool = False, data_format: str = "NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.data_format = mode, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode=self.mode, data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW"):
+        super().__init__(size, scale_factor, "bilinear", True, data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW"):
+        super().__init__(size, scale_factor, "nearest", False, data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, *self.args)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW"):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCDHW"):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)

@@ -22,6 +22,7 @@ FLOOR = {
     "paddle.random": 15,
     "paddle.linalg": 28,
     "paddle.nn.functional": 100,
+    "paddle.nn": 97,
     "paddle.incubate": 9,
     "paddle.distributed": 13,
     "paddle.optimizer": 9,

@@ -1,0 +1,126 @@
+"""Recurrent layers vs torch (whose gate layouts paddle shares):
+SimpleRNN/LSTM/GRU, uni- and bidirectional, multi-layer, cells, and
+paddle's sequence_length (frozen-state / zeroed-output) semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+from paddle_tpu.nn.rnn import GRU, LSTM, GRUCell, LSTMCell, SimpleRNN
+
+rs = np.random.RandomState(0)
+B, T, IN, H, L = 3, 7, 5, 6, 2
+
+
+def _copy_weights(ours, theirs, layers, ndir):
+    for layer in range(layers):
+        for d in range(ndir):
+            sfx = f"_l{layer}" + ("_reverse" if d else "")
+            cell = ours.cells[layer * ndir + d]
+            cell.weight_ih = jnp.asarray(
+                getattr(theirs, "weight_ih" + sfx).detach().numpy())
+            cell.weight_hh = jnp.asarray(
+                getattr(theirs, "weight_hh" + sfx).detach().numpy())
+            cell.bias_ih = jnp.asarray(
+                getattr(theirs, "bias_ih" + sfx).detach().numpy())
+            cell.bias_hh = jnp.asarray(
+                getattr(theirs, "bias_hh" + sfx).detach().numpy())
+
+
+@pytest.mark.parametrize("tcls,ocls,direction", [
+    (torch.nn.LSTM, LSTM, "forward"),
+    (torch.nn.LSTM, LSTM, "bidirect"),
+    (torch.nn.GRU, GRU, "forward"),
+    (torch.nn.GRU, GRU, "bidirect"),
+    (torch.nn.RNN, SimpleRNN, "forward"),
+])
+def test_rnn_matches_torch(tcls, ocls, direction):
+    bi = direction != "forward"
+    t = tcls(IN, H, num_layers=L, batch_first=True, bidirectional=bi)
+    o = ocls(IN, H, num_layers=L, direction=direction)
+    _copy_weights(o, t, L, 2 if bi else 1)
+    x = rs.randn(B, T, IN).astype(np.float32)
+    ref_out, ref_state = t(torch.tensor(x))
+    out, state = o(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out),
+                               ref_out.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+    if isinstance(ref_state, tuple):
+        for ours_s, ref_s in zip(state, ref_state):
+            np.testing.assert_allclose(np.asarray(ours_s),
+                                       ref_s.detach().numpy(), rtol=1e-5,
+                                       atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(state),
+                                   ref_state.detach().numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_rnn_initial_states_flow():
+    t = torch.nn.LSTM(IN, H, num_layers=1, batch_first=True)
+    o = LSTM(IN, H)
+    _copy_weights(o, t, 1, 1)
+    x = rs.randn(B, T, IN).astype(np.float32)
+    h0 = rs.randn(1, B, H).astype(np.float32)
+    c0 = rs.randn(1, B, H).astype(np.float32)
+    ref_out, _ = t(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+    out, _ = o(jnp.asarray(x), initial_states=(jnp.asarray(h0),
+                                               jnp.asarray(c0)))
+    np.testing.assert_allclose(np.asarray(out), ref_out.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_length_freezes_state_and_zeroes_output():
+    pt.seed(0)
+    o = LSTM(IN, H)
+    x = jnp.asarray(rs.randn(B, T, IN).astype(np.float32))
+    out, (h, c) = o(x, sequence_length=jnp.asarray([T, 4, 2]))
+    # the final state of row 1 equals running only its 4 valid steps
+    out_ref, (h_ref, _) = o(x[1:2, :4])
+    np.testing.assert_allclose(np.asarray(h[0, 1]), np.asarray(h_ref[0, 0]),
+                               rtol=1e-5, atol=1e-6)
+    # outputs past the valid length are zero
+    assert float(jnp.max(jnp.abs(out[1, 4:]))) == 0.0
+    assert float(jnp.max(jnp.abs(out[2, 2:]))) == 0.0
+
+
+def test_cells_match_torch_single_step():
+    for tcls, ocls in [(torch.nn.LSTMCell, LSTMCell),
+                       (torch.nn.GRUCell, GRUCell)]:
+        t = tcls(IN, H)
+        o = ocls(IN, H)
+        o.weight_ih = jnp.asarray(t.weight_ih.detach().numpy())
+        o.weight_hh = jnp.asarray(t.weight_hh.detach().numpy())
+        o.bias_ih = jnp.asarray(t.bias_ih.detach().numpy())
+        o.bias_hh = jnp.asarray(t.bias_hh.detach().numpy())
+        x = rs.randn(B, IN).astype(np.float32)
+        tout = t(torch.tensor(x))
+        out, _ = o(jnp.asarray(x))
+        ref = tout[0] if isinstance(tout, tuple) else tout
+        np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_trains_under_jit():
+    """The scan-based LSTM must be jit/grad-compatible end to end."""
+    from paddle_tpu.nn.layer import functional_call
+
+    pt.seed(3)
+    model = LSTM(IN, H)
+    params = model.state_dict()
+    x = jnp.asarray(rs.randn(B, T, IN).astype(np.float32))
+    y = jnp.asarray(rs.randn(B, T, H).astype(np.float32))
+
+    @jax.jit
+    def loss_fn(p):
+        out, _ = functional_call(model, p, x)
+        return jnp.mean((out - y) ** 2)
+
+    g = jax.grad(loss_fn)(params)
+    assert all(bool(jnp.any(v != 0)) for v in g.values())
+    l0 = float(loss_fn(params))
+    params2 = {k: v - 0.05 * g[k] for k, v in params.items()}
+    assert float(loss_fn(params2)) < l0
