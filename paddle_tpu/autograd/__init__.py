@@ -1,0 +1,190 @@
+"""paddle.autograd functional surface (upstream layout:
+python/paddle/autograd/ — ``grad``, the functional ``jacobian``/
+``hessian``, ``paddle.incubate.autograd.vjp``/``jvp``, ``no_grad`` and
+``PyLayer``).
+
+TPU-native design: the reference's tape (dygraph autograd engine,
+``Tensor.backward`` walking recorded ops) is replaced by jax's functional
+transforms — there is no tape to walk, so every API here takes a
+*function* and returns values/derivatives purely.  That is the same
+design stance the registry records for ``Tensor.backward`` (declared
+design-absent): gradients flow through ``grad(fn)``, not through mutable
+``.grad`` fields.
+
+  * :func:`grad` is jax.grad with paddle's argument spelling;
+  * :func:`jacobian`/:func:`hessian` pick forward- vs reverse-mode the way
+    jax does (jacfwd for tall, jacrev for wide is the caller's choice via
+    ``mode``);
+  * :class:`PyLayer` is the custom-VJP escape hatch (parity:
+    paddle.autograd.PyLayer with ``forward``/``backward`` staticmethods),
+    lowered onto ``jax.custom_vjp``;
+  * :func:`no_grad` exists for API compatibility: jax computes gradients
+    only where a transform asks, so it is a no-op context manager whose
+    body additionally wraps values in ``stop_gradient`` when used as a
+    decorator.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grad", "jacobian", "hessian", "vjp", "jvp", "no_grad",
+           "PyLayer", "PyLayerContext"]
+
+
+def _as_tuple(x):
+    return x if isinstance(x, (tuple, list)) else (x,)
+
+
+def grad(func: Callable, argnums=0, has_aux: bool = False,
+         allow_unused: bool = False, create_graph: bool = True):
+    """Functional gradient (parity: paddle.grad re-expressed over
+    functions — the tape-walking form has no jax equivalent by design).
+
+    ``create_graph`` is accepted for signature parity and ignored: jax
+    gradients are always differentiable again.  ``allow_unused`` is
+    likewise free — unused inputs simply get zero cotangents.
+    """
+    del allow_unused, create_graph
+    return jax.grad(func, argnums=argnums, has_aux=has_aux)
+
+
+def jacobian(func: Callable, xs, mode: str = "reverse"):
+    """Full Jacobian of ``func`` at ``xs`` (parity: paddle.autograd.
+    jacobian's batch=False single-call form).
+
+    ``mode``: "reverse" (jacrev — wide outputs) or "forward" (jacfwd —
+    tall outputs); the reference auto-selects inside its matmul-free
+    double-vjp machinery, here the two jax transforms are exposed
+    directly.
+    """
+    xs_t = _as_tuple(xs)
+    argnums = tuple(range(len(xs_t)))
+    jac_fn = {"reverse": jax.jacrev, "forward": jax.jacfwd}[mode]
+    out = jac_fn(func, argnums=argnums)(*xs_t)
+    if not isinstance(xs, (tuple, list)) and isinstance(out, tuple):
+        out = out[0]  # single input: unwrap the per-argument tuple layer
+    return out
+
+
+def hessian(func: Callable, xs):
+    """Hessian of a scalar-valued ``func`` (parity: paddle.autograd.
+    hessian): forward-over-reverse, jax's efficient composition."""
+    xs_t = _as_tuple(xs)
+    argnums = tuple(range(len(xs_t)))
+    out = jax.jacfwd(jax.jacrev(func, argnums=argnums),
+                     argnums=argnums)(*xs_t)
+    if not isinstance(xs, (tuple, list)):
+        out = out[0][0]
+    return out
+
+
+def vjp(func: Callable, xs, v=None):
+    """(outputs, vjp_result) — parity: paddle.incubate.autograd.vjp.
+
+    ``v``: cotangents matching the output structure; defaults to ones
+    (the reference's convention for scalar-like use)."""
+    xs_t = _as_tuple(xs)
+    out, pullback = jax.vjp(func, *xs_t)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    grads = pullback(v)
+    if not isinstance(xs, (tuple, list)):
+        grads = grads[0]
+    return out, grads
+
+
+def jvp(func: Callable, xs, v=None):
+    """(outputs, jvp_result) — parity: paddle.incubate.autograd.jvp."""
+    xs_t = _as_tuple(xs)
+    if v is None:
+        v_t = tuple(jnp.ones_like(jnp.asarray(x)) for x in xs_t)
+    else:
+        v_t = _as_tuple(v)
+    out, tangent = jax.jvp(func, xs_t, v_t)
+    return out, tangent
+
+
+class _NoGrad(contextlib.ContextDecorator):
+    """paddle.no_grad parity.  As a context manager: a no-op (jax only
+    differentiates where a transform asks).  As a decorator: additionally
+    stops gradients through the wrapped function's outputs, matching the
+    reference's semantics for code that *is* under an outer grad."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __call__(self, func=None):
+        if func is None:
+            return self
+
+        def wrapper(*args, **kwargs):
+            return jax.tree_util.tree_map(
+                jax.lax.stop_gradient, func(*args, **kwargs))
+
+        return wrapper
+
+
+no_grad = _NoGrad()
+
+
+class PyLayerContext:
+    """Forward-to-backward side channel (parity: paddle.autograd.
+    PyLayerContext): ``save_for_backward`` stores residuals, read back via
+    ``saved_tensor`` in backward."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom-gradient layer (parity: paddle.autograd.PyLayer).
+
+    Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    staticmethods, call via ``.apply(*args)``.  Lowered onto
+    ``jax.custom_vjp``: forward runs once per trace, the ctx's saved
+    tensors become the VJP residuals — so apply() composes with
+    jit/grad/vmap like any jax function.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        @jax.custom_vjp
+        def fn(*a):
+            ctx = PyLayerContext()
+            return cls.forward(ctx, *a, **kwargs)
+
+        def fwd(*a):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *a, **kwargs)
+            return out, ctx._saved
+
+        def bwd(saved, g):
+            ctx = PyLayerContext()
+            ctx._saved = saved
+            grads = cls.backward(ctx, *_as_tuple(g))
+            return _as_tuple(grads)
+
+        fn.defvjp(fwd, bwd)
+        return fn(*args)

@@ -30,8 +30,10 @@ is returned so callers can pin it in tests (a collective-order regression
 is then a visible diff, the reference's "log the NCCL op sequence"
 debugging technique made structural).
 
-Enable at train-step build time with ``FLAGS_collective_lint`` — it runs
-once at trace time, costs nothing per step.
+``FLAGS_collective_lint`` makes every ``build_train_step`` product run
+this lint at its first call (the earliest point batch shapes exist) —
+one abstract trace, nothing per step after.  The dryrun and the pair
+tests also invoke it directly.
 """
 
 from __future__ import annotations

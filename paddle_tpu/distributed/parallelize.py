@@ -219,6 +219,22 @@ def build_train_step(model: Layer, optimizer,
     step_jit = jax.jit(step, donate_argnums=(0, 1) if donate else (),
                        out_shardings=(NamedSharding(mesh, P()), p_shard,
                                       o_shard))
+    if bool(_flag("collective_lint")):
+        # lint the step's collective schedule once, at first call (the
+        # earliest point the batch shapes exist), before any execution —
+        # a rank-divergence hazard raises CollectiveOrderError instead of
+        # deadlocking on hardware.  Abstract trace only: costs one extra
+        # trace on the first step, nothing after.
+        from .lint import check_collective_order
+        linted = []
+
+        def step_with_lint(p, o, batch, rng):
+            if not linted:
+                check_collective_order(step, p, o, batch, rng)
+                linted.append(True)
+            return step_jit(p, o, batch, rng)
+
+        return step_with_lint, params, opt_state
     return step_jit, params, opt_state
 
 

@@ -98,6 +98,11 @@ DEFINE("matmul_precision", "default",
 DEFINE("log_level", 0, "VLOG-style verbosity for paddle_tpu's own logging")
 DEFINE("allocator_strategy", "xla",
        "parity flag: the reference exposes auto_growth; on TPU, XLA owns memory")
+DEFINE("collective_lint", False,
+       "lint the collective schedule of every built train step "
+       "(distributed/lint.py) at its first call — raises "
+       "CollectiveOrderError on rank-divergence hazards instead of "
+       "deadlocking on hardware")
 DEFINE("pallas_interpret", False,
        "run Pallas kernels in interpreter mode (for CPU tests)")
 DEFINE("moe_dispatch", "dense",

@@ -143,6 +143,10 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "fused_bias_dropout_residual_layer_norm",
         "variable_length_memory_efficient_attention",
         "fused_multi_transformer",
+        # round-5 tranche: the remaining incubate fusion surface
+        "fused_linear", "fused_linear_activation", "fused_dropout_add",
+        "fused_layer_norm", "fused_feedforward", "fused_attention",
+        "masked_multihead_attention",
     ],
     "paddle.distributed": [
         "all_gather", "all_reduce", "all_to_all", "barrier", "broadcast",
@@ -211,6 +215,29 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         "relu", "relu6", "leaky_relu", "softmax", "attention", "conv3d",
         "subm_conv3d",
     ],
+    # -- round-5 tranche namespaces ----------------------------------------
+    "paddle.distribution": [
+        "Distribution", "ExponentialFamily",
+        "Bernoulli", "Beta", "Binomial", "Categorical", "Cauchy", "Chi2",
+        "ContinuousBernoulli", "Dirichlet", "Exponential", "Gamma",
+        "Geometric", "Gumbel", "Independent", "Laplace", "LKJCholesky",
+        "LogNormal", "Multinomial", "MultivariateNormal", "Normal",
+        "Poisson", "StudentT", "TransformedDistribution", "Uniform",
+        "kl_divergence", "register_kl",
+        "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+        "ExpTransform", "IndependentTransform", "PowerTransform",
+        "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+        "StackTransform", "StickBreakingTransform", "TanhTransform",
+    ],
+    "paddle.autograd": [
+        "grad", "jacobian", "hessian", "vjp", "jvp", "no_grad", "PyLayer",
+    ],
+    "paddle.nn.quant": [
+        "weight_quantize", "weight_dequantize", "weight_only_linear",
+        "llm_int8_linear",
+    ],
+    "paddle.metric": ["Metric", "Accuracy", "Auc", "Precision", "Recall"],
+    "paddle.amp": ["auto_cast", "decorate", "GradScaler"],
     "paddle.Tensor": [
         # method surface of the Tensor facade (tensor_facade.py): resolved
         # by attribute lookup on a live instance, so jax.Array fallthrough
@@ -224,6 +251,19 @@ TARGET_SURFACE: Dict[str, List[str]] = {
         # have no functional-jax equivalent yet):
         "backward", "register_hook", "pin_memory",
     ],
+}
+
+# Flag-level scope limits: names the registry counts as implemented whose
+# behaviour under a specific argument is a documented NotImplementedError.
+# The name-keyed queue cannot see these (round-4 verdict weak #4), so they
+# are pinned here — visible, and test-enforced (tests/test_doc_truth.py):
+# each entry must point at a real callable whose limit still raises.
+KNOWN_SCOPE_LIMITS: Dict[str, str] = {
+    "paddle_tpu.vision.ops:yolo_box":
+        "iou_aware=True (extra per-anchor IoU channel) raises",
+    "paddle_tpu.sparse.nn:conv3d":
+        "groups>1 raises; coordinate matching runs host-side in NumPy — "
+        "a parity surface, not a jit-traceable point-cloud kernel",
 }
 
 # Paddle names whose implementation deliberately lives under a different
@@ -259,6 +299,11 @@ _IMPL_MODULES: Dict[str, List[str]] = {
     "paddle.vision.ops": ["paddle_tpu.vision.ops"],
     "paddle.sparse": ["paddle_tpu.sparse"],
     "paddle.sparse.nn": ["paddle_tpu.sparse.nn"],
+    "paddle.distribution": ["paddle_tpu.distribution"],
+    "paddle.autograd": ["paddle_tpu.autograd"],
+    "paddle.nn.quant": ["paddle_tpu.nn.quant"],
+    "paddle.metric": ["paddle_tpu.metric"],
+    "paddle.amp": ["paddle_tpu.amp"],
     "paddle.Tensor": [],  # resolved against a facade instance, see resolve()
 }
 

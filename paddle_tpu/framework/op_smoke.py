@@ -271,6 +271,7 @@ def smoke_cases() -> Dict[str, Callable[[], Any]]:
             jnp.ones((1, 4, 2), jnp.float32)),
     }
     special.update(_round4_cases(I))
+    special.update(_round5_cases(I))
 
     cases: Dict[str, Callable[[], Any]] = {}
     for cat, names in op_registry.TARGET_SURFACE.items():
@@ -278,6 +279,293 @@ def smoke_cases() -> Dict[str, Callable[[], Any]]:
             cases[f"{cat}:{name}"] = _make_thunk(cat, name, special,
                                                  x, y, unit, pos, idx)
     return cases
+
+
+def _round5_cases(I):
+    """Smoke calls for the round-5 tranche (distribution, autograd
+    functional, remaining incubate fusions, weight-only quant, metric,
+    amp).  All keys are 'category:name'-qualified."""
+    x, unit, pos = I["x"], I["unit"], I["pos"]
+    key = jax.random.key(0)
+
+    def dist_case(maker, value, discrete=False, has_entropy=True):
+        """Construct → sample → log_prob (→ entropy): the whole method
+        surface must lower, not just __init__."""
+        def run(cls):
+            d = maker(cls)
+            s = d.sample((2,), key=key)
+            jax.block_until_ready(s)
+            lp = d.log_prob(value)
+            jax.block_until_ready(lp)
+            if has_entropy:
+                jax.block_until_ready(d.entropy())
+            return s, lp
+        return run
+
+    half = jnp.asarray(0.4, jnp.float32)
+    two = jnp.asarray(2.0, jnp.float32)
+    one = jnp.asarray(1.0, jnp.float32)
+    simplex = jnp.asarray([0.2, 0.3, 0.5], jnp.float32)
+
+    def transform_case(maker, value):
+        """forward → inverse → forward_log_det_jacobian round trip."""
+        def run(cls):
+            t = maker(cls)
+            y = t.forward(value)
+            jax.block_until_ready(y)
+            jax.block_until_ready(t.inverse(y))
+            try:
+                jax.block_until_ready(t.forward_log_det_jacobian(value))
+            except NotImplementedError:
+                pass  # non-bijective convention transforms (Softmax)
+            return y
+        return run
+
+    def kl_case(f):
+        from .. import distribution as D
+        return f(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+
+    def register_kl_case(f):
+        from .. import distribution as D
+
+        class _A(D.Normal):
+            pass
+
+        @f(_A, _A)
+        def _kl(p, q_):
+            return D.kl_divergence(
+                D.Normal(p.loc, p.scale), D.Normal(q_.loc, q_.scale))
+
+        return D.kl_divergence(_A(0.0, 1.0), _A(0.0, 1.0))
+
+    def pylayer_case(cls):
+        class Double(cls):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return 2 * a
+
+            @staticmethod
+            def backward(ctx, g):
+                return 2 * g
+
+        out = Double.apply(x)
+        jax.block_until_ready(out)
+        return jax.grad(lambda a: jnp.sum(Double.apply(a)))(x)
+
+    def quant_roundtrip(algo):
+        def run(f):
+            w = jnp.asarray(np.random.default_rng(3).normal(size=(4, 8)),
+                            jnp.float32)
+            return f(w, algo=algo)
+        return run
+
+    def wol_case(f):
+        from ..nn.quant import weight_quantize
+        w = jnp.asarray(np.random.default_rng(3).normal(size=(3, 8)),
+                        jnp.float32)
+        qw, sc = weight_quantize(w)
+        return f(x, qw, weight_scale=sc)
+
+    def dequant_case(f):
+        from ..nn.quant import weight_quantize
+        qw, sc = weight_quantize(jnp.ones((4, 8), jnp.float32))
+        return f(qw, sc)
+
+    def metric_case(name):
+        def run(cls):
+            m = cls()
+            if name == "Accuracy":
+                m.update(m.compute(jnp.asarray([[0.1, 0.9], [0.8, 0.2]]),
+                                   jnp.asarray([[1], [0]])))
+            elif name in ("Precision", "Recall"):
+                m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+            elif name == "Auc":
+                m.update(jnp.asarray([[0.6, 0.4], [0.3, 0.7]]),
+                         jnp.asarray([[0], [1]]))
+            return m.accumulate()
+
+        def base(cls):  # Metric: abstract base — subclassable is the API
+            class _M(cls):
+                def name(self):
+                    return "m"
+
+                def update(self, *a):
+                    pass
+
+                def accumulate(self):
+                    return 0.0
+
+                def reset(self):
+                    pass
+            _M().update()
+            return _M().accumulate()
+        return base if name == "Metric" else run
+
+    def autocast_case(f):
+        with f(enable=True):
+            out = x @ jnp.ones((3, 2), jnp.float32)
+        jax.block_until_ready(out)
+        return out
+
+    def scaler_case(cls):
+        sc = cls(init_loss_scaling=2.0)
+        state = sc.init_state()
+        return jax.block_until_ready(sc.scale_with(state, jnp.sum(x)))
+
+    def decorate_case(f):
+        from ..nn import Linear
+        model = Linear(3, 2)
+        return f(model, level="O2")
+
+    D = "paddle.distribution"
+    return {
+        # -- distribution construct/sample/log_prob/entropy ----------------
+        f"{D}:Normal": dist_case(lambda c: c(0.0, 1.0), half),
+        f"{D}:Uniform": dist_case(lambda c: c(0.0, 1.0), half),
+        f"{D}:Laplace": dist_case(lambda c: c(0.0, 1.0), half),
+        f"{D}:Gumbel": dist_case(lambda c: c(0.0, 1.0), half),
+        f"{D}:Cauchy": dist_case(lambda c: c(0.0, 1.0), half),
+        f"{D}:Exponential": dist_case(lambda c: c(one), half),
+        f"{D}:StudentT": dist_case(lambda c: c(two, 0.0, 1.0), half),
+        f"{D}:Gamma": dist_case(lambda c: c(two, two), half),
+        f"{D}:Chi2": dist_case(lambda c: c(two), half),
+        f"{D}:Beta": dist_case(lambda c: c(two, two), half),
+        f"{D}:Dirichlet": dist_case(lambda c: c(simplex * 3), simplex),
+        f"{D}:Bernoulli": dist_case(lambda c: c(half), one),
+        f"{D}:Geometric": dist_case(lambda c: c(half), two),
+        f"{D}:Poisson": dist_case(lambda c: c(two), two),
+        f"{D}:Binomial": dist_case(lambda c: c(jnp.asarray(8), half), two,
+                                   has_entropy=False),
+        f"{D}:Categorical": dist_case(lambda c: c(jnp.log(simplex)),
+                                      jnp.asarray(1)),
+        f"{D}:Multinomial": dist_case(lambda c: c(6, simplex),
+                                      jnp.asarray([1.0, 2.0, 3.0]),
+                                      has_entropy=False),
+        f"{D}:MultivariateNormal": dist_case(
+            lambda c: c(jnp.zeros(2),
+                        covariance_matrix=jnp.asarray([[2.0, 0.5],
+                                                       [0.5, 1.0]])),
+            jnp.asarray([0.3, -0.2])),
+        f"{D}:LKJCholesky": dist_case(
+            lambda c: c(3, 1.5), jnp.eye(3), has_entropy=False),
+        f"{D}:LogNormal": dist_case(lambda c: c(0.0, 1.0), half,
+                                    has_entropy=True),
+        f"{D}:ContinuousBernoulli": dist_case(lambda c: c(half), half,
+                                              has_entropy=False),
+        f"{D}:Independent": dist_case(
+            lambda c: (lambda D_: c(D_.Normal(jnp.zeros(3), jnp.ones(3)),
+                                    1))(_dist_mod()), jnp.zeros(3)),
+        f"{D}:TransformedDistribution": dist_case(
+            lambda c: (lambda D_: c(D_.Normal(0.0, 1.0),
+                                    [D_.ExpTransform()]))(_dist_mod()),
+            pos[0, 0], has_entropy=False),
+        f"{D}:Distribution": lambda c: c((), ()).batch_shape,
+        f"{D}:ExponentialFamily": lambda c: issubclass(c, object),
+        f"{D}:kl_divergence": kl_case,
+        f"{D}:register_kl": register_kl_case,
+        # -- transforms ----------------------------------------------------
+        f"{D}:Transform": lambda c: isinstance(c(), c),
+        f"{D}:ExpTransform": transform_case(lambda c: c(), x),
+        f"{D}:AbsTransform": transform_case(lambda c: c(), x),
+        f"{D}:AffineTransform": transform_case(lambda c: c(1.0, 2.0), x),
+        f"{D}:PowerTransform": transform_case(lambda c: c(2.0), pos),
+        f"{D}:SigmoidTransform": transform_case(lambda c: c(), x),
+        f"{D}:TanhTransform": transform_case(lambda c: c(), unit - 0.5),
+        f"{D}:SoftmaxTransform": transform_case(lambda c: c(), x),
+        f"{D}:StickBreakingTransform": transform_case(
+            lambda c: c(), jnp.asarray([0.3, -0.2])),
+        f"{D}:ReshapeTransform": transform_case(
+            lambda c: c((3,), (3, 1)), x),
+        f"{D}:IndependentTransform": transform_case(
+            lambda c: (lambda D_: c(D_.ExpTransform(), 1))(_dist_mod()),
+            x),
+        f"{D}:ChainTransform": transform_case(
+            lambda c: (lambda D_: c([D_.AffineTransform(0.0, 2.0),
+                                     D_.ExpTransform()]))(_dist_mod()),
+            x),
+        f"{D}:StackTransform": transform_case(
+            lambda c: (lambda D_: c([D_.ExpTransform(),
+                                     D_.TanhTransform()], axis=0))(
+                _dist_mod()),
+            jnp.stack([x[0], x[1]])),
+        # -- autograd functional -------------------------------------------
+        "paddle.autograd:grad":
+            lambda f: f(lambda a: jnp.sum(a * a))(x),
+        "paddle.autograd:jacobian":
+            lambda f: f(lambda a: jnp.sin(a), I["v"]),
+        "paddle.autograd:hessian":
+            lambda f: f(lambda a: jnp.sum(a * a), I["v"]),
+        "paddle.autograd:vjp":
+            lambda f: f(lambda a: jnp.sum(a * a), x),
+        "paddle.autograd:jvp":
+            lambda f: f(lambda a: a * a, x),
+        "paddle.autograd:no_grad":
+            lambda f: f(lambda a: a * 2)(x),
+        "paddle.autograd:PyLayer": pylayer_case,
+        # -- incubate fusions (round 5) ------------------------------------
+        "paddle.incubate:fused_linear":
+            lambda f: f(x, jnp.ones((3, 4), jnp.float32),
+                        jnp.zeros((4,), jnp.float32)),
+        "paddle.incubate:fused_linear_activation":
+            lambda f: f(x, jnp.ones((3, 4), jnp.float32),
+                        jnp.zeros((4,), jnp.float32), activation="gelu"),
+        "paddle.incubate:fused_dropout_add":
+            lambda f: f(x, I["y"], p=0.0),
+        "paddle.incubate:fused_layer_norm":
+            lambda f: f(x, jnp.ones((3,), jnp.float32),
+                        jnp.zeros((3,), jnp.float32), 1e-5,
+                        residual=I["y"]),
+        "paddle.incubate:fused_feedforward":
+            lambda f: f(jnp.ones((1, 4, 8), jnp.float32),
+                        jnp.ones((8, 16), jnp.float32),
+                        jnp.ones((16, 8), jnp.float32),
+                        dropout1_rate=0.0, dropout2_rate=0.0,
+                        ln2_scale=jnp.ones((8,), jnp.float32)),
+        "paddle.incubate:fused_attention": _fused_attention_case,
+        "paddle.incubate:masked_multihead_attention": _mmha_case,
+        # -- weight-only quant ---------------------------------------------
+        "paddle.nn.quant:weight_quantize":
+            quant_roundtrip("weight_only_int8"),
+        "paddle.nn.quant:weight_dequantize": dequant_case,
+        "paddle.nn.quant:weight_only_linear": wol_case,
+        "paddle.nn.quant:llm_int8_linear": wol_case,
+        # -- metric / amp --------------------------------------------------
+        "paddle.metric:Metric": metric_case("Metric"),
+        "paddle.metric:Accuracy": metric_case("Accuracy"),
+        "paddle.metric:Precision": metric_case("Precision"),
+        "paddle.metric:Recall": metric_case("Recall"),
+        "paddle.metric:Auc": metric_case("Auc"),
+        "paddle.amp:auto_cast": autocast_case,
+        "paddle.amp:GradScaler": scaler_case,
+        "paddle.amp:decorate": decorate_case,
+    }
+
+
+def _dist_mod():
+    from .. import distribution
+    return distribution
+
+
+def _fused_attention_case(f):
+    rng = np.random.default_rng(5)
+    e, nh, hd = 8, 2, 4
+    x = jnp.asarray(rng.normal(size=(1, 4, e)), jnp.float32)
+    qkv_w = jnp.asarray(rng.normal(size=(3, nh, hd, e)) * 0.1, jnp.float32)
+    lin_w = jnp.asarray(rng.normal(size=(nh * hd, e)) * 0.1, jnp.float32)
+    return f(x, qkv_w, lin_w, dropout_rate=0.0, attn_dropout_rate=0.0,
+             ln_scale=jnp.ones((e,), jnp.float32))
+
+
+def _mmha_case(f):
+    rng = np.random.default_rng(6)
+    b, h, d, max_len = 2, 2, 4, 8
+    x = jnp.asarray(rng.normal(size=(b, 3 * h * d)), jnp.float32)
+    cache = jnp.zeros((2, b, h, max_len, d), jnp.float32)
+    out, cache = f(x, cache,
+                   sequence_lengths=jnp.asarray([0, 3], jnp.int32))
+    jax.block_until_ready(out)
+    return out
 
 
 def _round4_cases(I):

@@ -133,12 +133,12 @@ class ErnieMoEDecoderLayer(Layer):
             return h + moe_out, aux
         return h + self.mlp(y), jnp.zeros((), jnp.float32)
 
-    def decode(self, x, rope_cache, pos, k_cache, v_cache):
-        a, k_cache, v_cache = self.self_attn.decode(
-            self.input_layernorm(x), rope_cache, pos, k_cache, v_cache)
+    def decode(self, x, rope_cache, pos, cache, idx: int):
+        a, cache = self.self_attn.decode(
+            self.input_layernorm(x), rope_cache, pos, cache, idx)
         h = x + a
         out, _ = self._ffn(h, self.post_attention_layernorm(h))
-        return out, k_cache, v_cache
+        return out, cache
 
 
 class ErnieMoEModel(Layer):
@@ -185,9 +185,7 @@ class ErnieMoEModel(Layer):
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         rope = (self.rope_cos, self.rope_sin)
         for i, block in enumerate(self.layers):
-            x, k_c, v_c = block.decode(x, rope, pos, cache[i, 0],
-                                       cache[i, 1])
-            cache = cache.at[i, 0].set(k_c).at[i, 1].set(v_c)
+            x, cache = block.decode(x, rope, pos, cache, i)
         return self.norm(x), cache
 
 

@@ -150,7 +150,12 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
     else:
         cache = init_kv_cache(model.config, b, total)
     params = model.state_dict(include_buffers=True)
-    params, cache, input_ids = _place_on_mesh(model, params, cache,
+    # quantized-decode hooks (models/quantized.py): ``unwrapped`` is the
+    # Layer to bind, ``_prepare_params`` dequantises the packed store
+    # in-graph; both default to the plain model
+    bind_target = getattr(model, "unwrapped", model)
+    prepare = getattr(model, "_prepare_params", lambda p: p)
+    params, cache, input_ids = _place_on_mesh(bind_target, params, cache,
                                               input_ids)
 
     def pick(logits, key):
@@ -182,7 +187,7 @@ def greedy_generate(model, input_ids, max_new_tokens: int,
 
     @jax.jit
     def run(params, input_ids, cache, key, extra):
-        with bind_params(model, params):
+        with bind_params(bind_target, prepare(params)):
             # prefill: one pass over the whole prompt.  pos is the STATIC
             # int 0 (not a traced scalar) so attention layers can route
             # prefill through the Pallas flash kernel (llama.py decode)
@@ -290,7 +295,9 @@ def beam_search_generate(model, input_ids, max_new_tokens: int,
     else:
         cache = init_kv_cache(model.config, b * k, total)
     params = model.state_dict(include_buffers=True)
-    params, cache, input_ids = _place_on_mesh(model, params, cache,
+    bind_target = getattr(model, "unwrapped", model)
+    prepare = getattr(model, "_prepare_params", lambda p: p)
+    params, cache, input_ids = _place_on_mesh(bind_target, params, cache,
                                               input_ids)
     # decode_step sees batch B·K, so per-row side inputs (e.g. a VLM's
     # vision features) must be beam-tiled too; beam-invariant, so no
@@ -308,7 +315,7 @@ def beam_search_generate(model, input_ids, max_new_tokens: int,
 
         @jax.jit
         def run(params, input_ids, cache, extra):
-            with bind_params(model, params):
+            with bind_params(bind_target, prepare(params)):
                 # prefill every beam with the same prompt (beams only
                 # diverge from step 1, when scores break the tie)
                 tiled = jnp.repeat(input_ids, k, axis=0)      # (B·K, S)

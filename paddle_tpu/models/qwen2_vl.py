@@ -272,8 +272,7 @@ class Qwen2VLForConditionalGeneration(Layer):
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
         rope = (self.rope_cos, self.rope_sin)
         for i, blk in enumerate(self.layers):
-            x, k_c, v_c = blk.decode(x, rope, pos, cache[i, 0], cache[i, 1])
-            cache = cache.at[i, 0].set(k_c).at[i, 1].set(v_c)
+            x, cache = blk.decode(x, rope, pos, cache, i)
             if i in self._cross_at:
                 x = self._cross_layer(i)(x, vision)
         return matmul(self.norm(x), self.lm_head), cache

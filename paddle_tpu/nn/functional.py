@@ -118,11 +118,21 @@ def swiglu(x, y=None):
 # dropout
 # ---------------------------------------------------------------------------
 
-def dropout(x, p: float = 0.5, training: bool = True, axis=None):
-    """Inverted dropout; RNG from the framework's site-key discipline so it is
-    reproducible under jit (see paddle_tpu/framework/random.py)."""
-    if not training or p == 0.0:
+def dropout(x, p: float = 0.5, training: bool = True, axis=None,
+            mode: str = "upscale_in_train"):
+    """Dropout; RNG from the framework's site-key discipline so it is
+    reproducible under jit (see paddle_tpu/framework/random.py).
+
+    ``mode`` (parity: paddle.nn.functional.dropout): "upscale_in_train"
+    (inverted dropout — scale kept units by 1/(1-p) at train, identity at
+    eval) or "downscale_in_infer" (no train-time scale; eval multiplies by
+    (1-p))."""
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"unknown dropout mode {mode!r}")
+    if p == 0.0:
         return x
+    if not training:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
     if p >= 1.0:
         return jnp.zeros_like(x)
     key = _random.site_key()
@@ -131,7 +141,8 @@ def dropout(x, p: float = 0.5, training: bool = True, axis=None):
         axes = (axis,) if isinstance(axis, int) else tuple(axis)
         shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
     keep = jax.random.bernoulli(key, 1.0 - p, shape)
-    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    scale = 1.0 - p if mode == "upscale_in_train" else 1.0
+    return jnp.where(keep, x / scale, jnp.zeros((), x.dtype))
 
 
 # ---------------------------------------------------------------------------

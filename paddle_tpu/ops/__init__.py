@@ -12,8 +12,10 @@ testing).
 from .attention import flash_attention, flash_attention_reference
 from .norms import rms_norm, rms_norm_reference
 from .rope import apply_rope, build_rope_cache, fused_rope
-from .fused import (fused_bias_dropout_residual_layer_norm,
-                    fused_multi_transformer,
+from .fused import (fused_attention, fused_bias_dropout_residual_layer_norm,
+                    fused_dropout_add, fused_feedforward, fused_layer_norm,
+                    fused_linear, fused_linear_activation,
+                    fused_multi_transformer, masked_multihead_attention,
                     variable_length_memory_efficient_attention)
 
 __all__ = [
@@ -23,4 +25,7 @@ __all__ = [
     "fused_bias_dropout_residual_layer_norm",
     "fused_multi_transformer",
     "variable_length_memory_efficient_attention",
+    "fused_attention", "fused_dropout_add", "fused_feedforward",
+    "fused_layer_norm", "fused_linear", "fused_linear_activation",
+    "masked_multihead_attention",
 ]

@@ -113,6 +113,59 @@ def flash_attention_reference(q, k, v, attn_mask=None, dropout_p: float = 0.0,
     return out
 
 
+def cached_decode_attention(q, k_cache, v_cache, pos,
+                            scale: Optional[float] = None,
+                            extra_mask=None):
+    """Incremental decode attention over a pre-allocated cache — the
+    serving hot path (parity: the reference's masked_multihead_attention /
+    fused decode-attention core, upstream
+    paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    q: (B, s, Hq, D) — the new tokens (s is 1 in steady-state decode);
+    k_cache/v_cache: (B, L, Hkv, D) with the new K/V already written at
+    ``pos..pos+s``; slots ``> pos+i`` are masked.
+
+    Decode is HBM-bound, so this path is shaped around traffic, where the
+    generic ``flash_attention_reference`` (a training oracle) is not:
+
+      * GQA stays *grouped* — Q reshapes to (B, s, Hkv, G, D) and the
+        einsums contract against the (B, L, Hkv, D) cache directly; the
+        oracle's ``_repeat_kv`` materialises Hq/Hkv copies;
+      * K/V enter the MXU as bf16 with fp32 *accumulation*
+        (preferred_element_type) — the oracle upcasts whole tensors to
+        fp32 first, 2x the bytes.  Only the (B, Hq, s, L) score tile is
+        fp32, and at s=1 it is KB-scale.
+
+    Measured (BENCH_DECODE.json, 940M llama, b=8, L=8192): this path +
+    in-place cache writes took the step from 42.7 ms to the weight-stream
+    regime — the round-4 "math path at decode" stance survives only with
+    this dataflow.  Returns (B, s, Hq, D) in q.dtype.
+    """
+    b, s, hq, d = q.shape
+    _, L, hkv, _ = k_cache.shape
+    g = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+    qg = q.reshape(b, s, hkv, g, d)
+    scores = jnp.einsum("bskgd,blkd->bkgsl", qg, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores * jnp.float32(scale)
+    qi = pos + jnp.arange(s)[:, None]                 # (s, 1)
+    kj = jnp.arange(L)[None, :]                       # (1, L)
+    keep = (kj <= qi)[None, None, None]               # (1,1,1,s,L)
+    if extra_mask is not None:
+        # bool; (B, L) key-padding form, or rank-3 broadcastable to
+        # (B, s, L) — lifted into the (B, Hkv, G, s, L) layout
+        em = extra_mask[:, None, :] if extra_mask.ndim == 2 else extra_mask
+        em = jnp.broadcast_to(em, (b, s, L))
+        keep = keep & em[:, None, None]
+    scores = jnp.where(keep, scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgsl,blkd->bskgd", w.astype(v_cache.dtype), v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s, hq, d).astype(q.dtype)
+
+
 def cache_mask(pos, q_len: int, kv_len: int):
     """Bool (1, 1, q_len, kv_len) mask for attention over a pre-allocated
     KV cache: query i (global position pos+i) may attend cache slot j iff

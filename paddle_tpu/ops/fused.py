@@ -19,7 +19,222 @@ import jax.numpy as jnp
 
 __all__ = ["fused_bias_dropout_residual_layer_norm",
            "variable_length_memory_efficient_attention",
-           "fused_multi_transformer"]
+           "fused_multi_transformer",
+           # round-5 tranche (remaining paddle.incubate.nn.functional)
+           "fused_linear", "fused_linear_activation", "fused_dropout_add",
+           "fused_layer_norm", "fused_feedforward", "fused_attention",
+           "masked_multihead_attention"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight: bool = False):
+    """matmul + bias in one call (parity: paddle.incubate.nn.functional.
+    fused_linear — the cublasLt gemm-epilogue wrapper).  Under jit XLA
+    fuses the bias add into the GEMM epilogue on its own; the name is the
+    API contract."""
+    w = jnp.swapaxes(weight, -1, -2) if transpose_weight else weight
+    y = x @ w
+    return y if bias is None else y + bias
+
+
+def fused_linear_activation(x, y, bias=None, trans_x: bool = False,
+                            trans_y: bool = False,
+                            activation: Optional[str] = None):
+    """GEMM + bias + activation epilogue (parity: paddle.incubate.nn.
+    functional.fused_linear_activation)."""
+    from ..nn import functional as F
+
+    a = jnp.swapaxes(x, -1, -2) if trans_x else x
+    b = jnp.swapaxes(y, -1, -2) if trans_y else y
+    out = a @ b
+    if bias is not None:
+        out = out + bias
+    act = {None: lambda v: v, "none": lambda v: v, "relu": F.relu,
+           "gelu": F.gelu}[activation]
+    return act(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training: bool = True,
+                      mode: str = "upscale_in_train", name=None):
+    """dropout(x) + y (parity: paddle.incubate.nn.functional.
+    fused_dropout_add — one kernel upstream, one fused XLA region here)."""
+    from ..nn import functional as F
+
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,
+                     residual_alpha: float = 1.0, begin_norm_axis: int = 1,
+                     bias=None, residual=None):
+    """(x·1 + bias + residual_alpha·residual) → LayerNorm (parity:
+    paddle.incubate.nn.functional.fused_layer_norm).  Returns the
+    normalised output; the pre-norm sum is recomputed free under XLA
+    fusion when a caller also needs it."""
+    from ..nn import functional as F
+
+    y = x
+    if bias is not None:
+        y = y + bias
+    if residual is not None:
+        y = y + residual_alpha * residual
+    shape = y.shape[begin_norm_axis:]
+    return F.layer_norm(y, list(shape), norm_weight, norm_bias,
+                        epsilon=epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None,
+                      dropout1_rate: float = 0.5,
+                      dropout2_rate: float = 0.5,
+                      activation: str = "relu",
+                      ln1_epsilon: float = 1e-5, ln2_epsilon: float = 1e-5,
+                      pre_layer_norm: bool = False,
+                      training: bool = True):
+    """The transformer FFN block as one call (parity: paddle.incubate.nn.
+    functional.fused_feedforward):
+
+        residual + dropout2(linear2(dropout1(act(linear1(ln(x))))))
+
+    with LN before (pre_layer_norm) or after the residual add."""
+    from ..nn import functional as F
+
+    act = {"relu": F.relu, "gelu": F.gelu}[activation]
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], ln1_scale, ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = h @ linear1_weight
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = F.dropout(act(h), p=dropout1_rate, training=training)
+    h = h @ linear2_weight
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    out = residual + F.dropout(h, p=dropout2_rate, training=training)
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                    pre_ln_scale=None, pre_ln_bias=None,
+                    ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                    qkv_bias=None, linear_bias=None, cache_kv=None,
+                    attn_mask=None, dropout_rate=0.5,
+                    attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                    training: bool = True):
+    """One whole attention block (parity: paddle.incubate.nn.functional.
+    fused_attention): LN → QKV → MHA → out-proj → dropout → residual
+    (→ LN when post-norm).  ``qkv_weight``: (3, num_head, head_dim,
+    embed_dim); ``cache_kv``: optional (2, B, num_head, max_len, head_dim)
+    to prepend (the reference's CacheKV decode form returns the attention
+    over cache+fresh keys)."""
+    from ..nn import functional as F
+    from .attention import flash_attention
+
+    b, s, e = x.shape
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [e], pre_ln_scale, pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    _, nh, hd, _ = qkv_weight.shape
+    qkv = jnp.einsum("bse,cnhe->cbsnh", h, qkv_weight)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape(3, 1, 1, nh, hd)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    if cache_kv is not None:
+        k = jnp.concatenate([jnp.swapaxes(cache_kv[0], 1, 2), k], 1)
+        v = jnp.concatenate([jnp.swapaxes(cache_kv[1], 1, 2), v], 1)
+    attn = flash_attention(q, k, v, causal=cache_kv is None,
+                           attn_mask=attn_mask,
+                           dropout_p=attn_dropout_rate if training else 0.0)
+    proj = attn.reshape(b, s, nh * hd) @ linear_weight
+    if linear_bias is not None:
+        proj = proj + linear_bias
+    out = residual + F.dropout(proj, p=dropout_rate, training=training)
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [e], ln_scale, ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def masked_multihead_attention(x, cache_kv, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               seq_len: int = 1,
+                               use_neox_rotary_style: bool = False):
+    """One-token decode attention over a KV cache (parity: paddle.incubate.
+    nn.functional.masked_multihead_attention — the reference's MMHA decode
+    kernel, upstream fused_multi_transformer's per-step core).
+
+    ``x``: (B, 3·H·D) fused QKV for the new token; ``cache_kv``:
+    (2, B, H, max_len, D); ``sequence_lengths``: (B,) tokens already in the
+    cache (defaults to 0 — the first step); ``src_mask``: optional
+    (B, 1, 1, max_len+…) additive mask; ``rotary_tensor``: optional
+    (B, 1, 1, D) [cos‖sin] rotary table for the current position (GPT-J
+    interleave by default, NeoX half-split with ``use_neox_rotary_style``).
+    Returns ``(out, cache_kv)`` with ``out``: (B, H·D).
+
+    TPU design: the cache write is ``lax.dynamic_update_slice`` per row
+    (vmap over the batch — rows decode at different positions), attention
+    is the masked math path over the cache, the serving-measured regime
+    (BENCH_DECODE.json) for single-token queries.
+    """
+    from .attention import NEG_INF
+
+    two, b, h, max_len, d = cache_kv.shape
+    assert two == 2
+    qkv = x.reshape(b, 3, h, d)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # (B, H, D)
+    if sequence_lengths is None:
+        pos = jnp.zeros((b,), jnp.int32)
+    else:
+        pos = jnp.asarray(sequence_lengths, jnp.int32).reshape(b)
+    if rotary_tensor is not None:
+        rot = rotary_tensor.reshape(b, 1, -1)           # (B, 1, 2·D/2…)
+        cos, sin = jnp.split(rot, 2, axis=-1)           # (B, 1, D/2)
+
+        def rope(t):
+            if use_neox_rotary_style:                   # half-split halves
+                t1, t2 = jnp.split(t, 2, axis=-1)
+            else:                                       # GPT-J interleave
+                t1, t2 = t[..., 0::2], t[..., 1::2]
+            r1 = t1 * cos - t2 * sin
+            r2 = t2 * cos + t1 * sin
+            if use_neox_rotary_style:
+                return jnp.concatenate([r1, r2], -1)
+            return jnp.stack([r1, r2], -1).reshape(t.shape)
+
+        q, k = rope(q), rope(k)
+
+    def write_row(cache_row, k_row, v_row, p):
+        kc = jax.lax.dynamic_update_slice(cache_row[0], k_row[:, None],
+                                          (0, p, 0))
+        vc = jax.lax.dynamic_update_slice(cache_row[1], v_row[:, None],
+                                          (0, p, 0))
+        return jnp.stack([kc, vc])
+
+    cache_kv = jax.vmap(write_row)(
+        jnp.swapaxes(cache_kv, 0, 1), k.astype(cache_kv.dtype),
+        v.astype(cache_kv.dtype), pos)
+    cache_kv = jnp.swapaxes(cache_kv, 0, 1)
+    kc, vc = cache_kv[0], cache_kv[1]                   # (B, H, L, D)
+    # bf16 operands, fp32 accumulation — the cached_decode_attention
+    # discipline: only the (B, H, L) score tile is fp32
+    scores = jnp.einsum("bhd,bhld->bhl", q, kc,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    valid = jnp.arange(max_len)[None, None, :] <= pos[:, None, None]
+    scores = jnp.where(valid, scores, jnp.float32(NEG_INF))
+    if src_mask is not None:
+        scores = scores + src_mask.reshape(b, 1, -1)[..., :max_len
+                                                     ].astype(jnp.float32)
+    w = jax.nn.softmax(scores, -1)
+    out = jnp.einsum("bhl,bhld->bhd", w.astype(vc.dtype), vc,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, h * d).astype(x.dtype), cache_kv
 
 
 def fused_bias_dropout_residual_layer_norm(
@@ -106,8 +321,8 @@ def fused_multi_transformer(
     convention.
     """
     from ..nn import functional as F
-    from .attention import (NEG_INF, cache_mask, flash_attention,
-                            flash_attention_reference)
+    from .attention import (NEG_INF, cache_mask, cached_decode_attention,
+                            flash_attention, flash_attention_reference)
 
     act = {"gelu": F.gelu, "relu": F.relu}[activation]
     b, s, _ = x.shape
@@ -136,28 +351,35 @@ def fused_multi_transformer(
 
         if cache_kvs is not None:
             cache = cache_kvs[i]                       # (2, B, nh, L, hd)
-            k_c = jax.lax.dynamic_update_slice(
-                cache[0], jnp.swapaxes(k, 1, 2).astype(cache.dtype),
-                (0, 0, pos, 0))
-            v_c = jax.lax.dynamic_update_slice(
-                cache[1], jnp.swapaxes(v, 1, 2).astype(cache.dtype),
-                (0, 0, pos, 0))
-            new_caches.append(jnp.stack([k_c, v_c]))
+            # chunk-sized in-place writes (never rebuild the full cache —
+            # the whole-slice jnp.stack form forced per-step cache copies;
+            # see LlamaAttention.decode's measured note)
+            cache = jax.lax.dynamic_update_slice(
+                cache, jnp.swapaxes(k, 1, 2).astype(cache.dtype)[None],
+                (0, 0, 0, pos, 0))
+            cache = jax.lax.dynamic_update_slice(
+                cache, jnp.swapaxes(v, 1, 2).astype(cache.dtype)[None],
+                (1, 0, 0, pos, 0))
+            new_caches.append(cache)
             if (isinstance(pos, int) and pos == 0 and s > 1
                     and attn_mask is None):
                 # prefill: attention over the cache at pos 0 is exactly
                 # causal attention over the fresh K/V — take the flash
                 # kernel instead of an O(S·max_len) masked math pass
                 attn = flash_attention(q, k, v, causal=True)
+            elif attn_mask is None:
+                attn = cached_decode_attention(
+                    q, jnp.swapaxes(cache[0], 1, 2),
+                    jnp.swapaxes(cache[1], 1, 2), pos)
             else:
-                mask = cache_mask(pos, s, k_c.shape[2])
-                if attn_mask is not None:  # padding masks compose
-                    mask = (mask & attn_mask
-                            if attn_mask.dtype == jnp.bool_
-                            else jnp.where(mask, attn_mask,
-                                           jnp.float32(NEG_INF)))
+                mask = cache_mask(pos, s, cache.shape[3])
+                mask = (mask & attn_mask
+                        if attn_mask.dtype == jnp.bool_
+                        else jnp.where(mask, attn_mask,
+                                       jnp.float32(NEG_INF)))
                 attn = flash_attention_reference(
-                    q, jnp.swapaxes(k_c, 1, 2), jnp.swapaxes(v_c, 1, 2),
+                    q, jnp.swapaxes(cache[0], 1, 2),
+                    jnp.swapaxes(cache[1], 1, 2),
                     attn_mask=mask, return_lse=False)
         else:
             # same semantics either way: causal, with an optional padding

@@ -12,9 +12,10 @@ sparse-sparse elementwise ops ride BCOO's sum-duplicates machinery.
 Pattern-captured kernels (round-4 queue shrink): ``masked_matmul`` is the
 SDDMM — gather rows/cols by the mask's indices and contract, O(nse·K),
 never materialising the dense product; ``nn.softmax`` runs per-row over
-stored values via segment max/sum.  Still absent (registry work queue):
-sparse attention and (subm_)conv3d — those need gather-scatter Pallas
-kernels with halo exchange when a model config demands them.
+stored values via segment max/sum; ``nn.attention`` and
+``nn.(subm_)conv3d`` live in :mod:`.nn` (conv3d does its coordinate
+matching host-side in NumPy — a parity surface, not a jit-traceable
+point-cloud kernel; see its docstring for the boundary).
 """
 
 from __future__ import annotations

@@ -103,6 +103,11 @@ def _sparse_conv3d_impl(x, weight, bias, stride, padding, dilation,
     data-dependent; the per-tap contraction is a batched (nse, Cin) @
     (Cin, Cout) matmul on device.  Submanifold mode pins the output
     coordinate set to the input's, the sparsity-preserving variant.
+
+    Boundary (op_registry.KNOWN_SCOPE_LIMITS): because the matching is
+    host-side NumPy, this op is NOT jit-traceable or differentiable and
+    rebuilds the rulebook per call — a parity surface for config-driven
+    models, not a production point-cloud kernel.  ``groups > 1`` raises.
     """
     import numpy as np
 

@@ -263,7 +263,8 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     if iou_aware:
         raise NotImplementedError(
             "iou_aware yolo_box (extra per-anchor IoU channel blended into "
-            "conf) is not implemented — registry work queue")
+            "conf) is a documented scope limit — see "
+            "op_registry.KNOWN_SCOPE_LIMITS")
     n, _, h, w = x.shape
     na = len(anchors) // 2
     an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)

@@ -218,3 +218,66 @@ def test_segment_ids_reject_cross_attention_and_accept_float_mask():
     want = flash_attention(q, k, v, causal=True, segment_ids=seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+# -- cached_decode_attention (round-5 serving path) --------------------------
+
+class TestCachedDecodeAttention:
+    """The decode hot path must match the training oracle exactly where
+    they overlap: attention over a cache with slots > pos masked."""
+
+    def _setup(self, b=2, L=16, hq=8, hkv=2, d=8, s=1, pos=9, seed=0):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(b, s, hq, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, L, hkv, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, L, hkv, d)), jnp.float32)
+        return q, k, v, pos
+
+    @pytest.mark.parametrize("s,pos", [(1, 0), (1, 9), (3, 5)])
+    def test_matches_reference_oracle(self, s, pos):
+        from paddle_tpu.ops.attention import (cache_mask,
+                                              cached_decode_attention,
+                                              flash_attention_reference)
+
+        q, k, v, _ = self._setup(s=s)
+        got = cached_decode_attention(q, k, v, pos)
+        want = flash_attention_reference(
+            q, k, v, attn_mask=cache_mask(pos, s, k.shape[1]),
+            return_lse=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_traced_pos_and_bf16(self):
+        from paddle_tpu.ops.attention import (cache_mask,
+                                              cached_decode_attention,
+                                              flash_attention_reference)
+
+        q, k, v, pos = self._setup()
+        q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
+        got = jax.jit(cached_decode_attention)(q, k, v, jnp.int32(pos))
+        assert got.dtype == jnp.bfloat16
+        want = flash_attention_reference(
+            q, k, v, attn_mask=cache_mask(pos, 1, k.shape[1]),
+            return_lse=False)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+
+    def test_extra_mask_composes(self):
+        from paddle_tpu.ops.attention import cached_decode_attention
+
+        q, k, v, pos = self._setup(b=1)
+        # forbid slots 0..3 on top of the cache mask
+        extra = (jnp.arange(k.shape[1]) >= 4)[None, None, :]
+        got = cached_decode_attention(q, k, v, pos,
+                                      extra_mask=extra)
+        # the (B, L) key-padding form must agree
+        got2d = cached_decode_attention(
+            q, k, v, pos, extra_mask=(jnp.arange(k.shape[1]) >= 4)[None])
+        np.testing.assert_allclose(np.asarray(got2d), np.asarray(got))
+        # equivalent: slice the allowed window [4..pos] and renormalise
+        want = cached_decode_attention(q[:, :, :, :],
+                                       k[:, 4:pos + 1], v[:, 4:pos + 1],
+                                       pos - 4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

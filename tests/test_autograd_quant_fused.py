@@ -1,0 +1,320 @@
+"""Round-5 tranche oracles: paddle.autograd functional surface (vs
+analytic/numpy derivatives), weight-only quantization (roundtrip error
+bounds + linear parity), and the remaining incubate fusions (vs unfused
+compositions / torch-free numpy references)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.autograd as ag
+from paddle_tpu.nn import quant
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    pt.seed(0)
+
+
+# ---------------------------------------------------------------------------
+# autograd
+# ---------------------------------------------------------------------------
+
+def test_grad_matches_analytic():
+    f = lambda x: jnp.sum(jnp.sin(x) * x)
+    x = jnp.asarray([0.3, 0.7])
+    g = np.asarray(ag.grad(f)(x))
+    want = np.cos([0.3, 0.7]) * [0.3, 0.7] + np.sin([0.3, 0.7])
+    np.testing.assert_allclose(g, want, rtol=1e-6)
+
+
+def test_jacobian_forward_equals_reverse():
+    x = jnp.asarray([0.3, 0.7, -1.2])
+    f = lambda v: jnp.stack([jnp.sum(v ** 2), jnp.prod(v)])
+    jr = np.asarray(ag.jacobian(f, x))
+    jf = np.asarray(ag.jacobian(f, x, mode="forward"))
+    np.testing.assert_allclose(jr, jf, rtol=1e-6)
+    want = np.stack([2 * np.asarray(x),
+                     np.prod(np.asarray(x)) / np.asarray(x)])
+    np.testing.assert_allclose(jr, want, rtol=1e-5)
+
+
+def test_hessian_matches_analytic():
+    x = jnp.asarray([0.5, 1.5])
+    f = lambda v: v[0] ** 3 + v[0] * v[1] ** 2
+    h = np.asarray(ag.hessian(f, x))
+    want = np.asarray([[6 * 0.5, 2 * 1.5], [2 * 1.5, 2 * 0.5]])
+    np.testing.assert_allclose(h, want, rtol=1e-5)
+
+
+def test_vjp_jvp_consistency():
+    """⟨v, J u⟩ == ⟨Jᵀ v, u⟩ — the defining adjoint identity."""
+    x = jnp.asarray([0.3, 0.7, -0.2])
+    f = lambda v: jnp.sin(v) * v[0]
+    u = jnp.asarray([0.1, -0.4, 0.9])
+    v = jnp.asarray([0.5, 0.2, -0.3])
+    _, jvp_out = ag.jvp(f, x, u)
+    _, vjp_out = ag.vjp(f, x, v)
+    np.testing.assert_allclose(float(jnp.vdot(v, jvp_out)),
+                               float(jnp.vdot(vjp_out, u)), rtol=1e-5)
+
+
+def test_pylayer_custom_vjp_and_composition():
+    class ClipGrad(ag.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return jnp.clip(g, -0.1, 0.1) * jnp.ones_like(x)
+
+    x = jnp.asarray([1.0, -2.0])
+    np.testing.assert_allclose(np.asarray(ClipGrad.apply(x)), [2.0, -4.0])
+    g = jax.grad(lambda v: jnp.sum(ClipGrad.apply(v) * 100))(x)
+    np.testing.assert_allclose(np.asarray(g), 0.1)  # clipped, not 200
+    # composes under jit + vmap
+    out = jax.jit(jax.vmap(ClipGrad.apply))(jnp.ones((3, 2)))
+    assert out.shape == (3, 2)
+
+
+def test_no_grad_decorator_stops_gradients():
+    fn = ag.no_grad(lambda x: x * 3)
+    g = jax.grad(lambda x: jnp.sum(fn(x)))(jnp.ones(3))
+    np.testing.assert_allclose(np.asarray(g), 0.0)
+    with ag.no_grad():                       # context form: plain no-op
+        assert float(jnp.sum(jnp.ones(2))) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# weight-only quant
+# ---------------------------------------------------------------------------
+
+def test_int8_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    qw, scale = quant.weight_quantize(w)
+    assert qw.dtype == jnp.int8 and scale.shape == (32,)
+    back = quant.weight_dequantize(qw, scale, out_dtype=jnp.float32)
+    # symmetric absmax int8: error ≤ scale/2 per element
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= np.asarray(scale) / 2 + 1e-6).all()
+
+
+def test_int4_roundtrip_and_packing():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(33, 16)), jnp.float32)  # odd K: pad
+    qw, scale = quant.weight_quantize(w, algo="weight_only_int4")
+    assert qw.shape == (17, 16)                # two nibbles per byte
+    back = quant.weight_dequantize(qw, scale, algo="weight_only_int4",
+                                   out_dtype=jnp.float32, k=33)
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= np.asarray(scale) / 2 + 1e-6).all()
+
+
+def test_weight_only_linear_parity():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(64, 32)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(32,)) * 0.1, jnp.bfloat16)
+    qw, scale = quant.weight_quantize(w)
+    got = quant.weight_only_linear(x, qw, bias=b, weight_scale=scale)
+    want = x @ w.astype(jnp.bfloat16) + b
+    # int8 weights: relative error dominated by quantisation, ~1%
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=0.05, atol=0.05)
+    got_llm = quant.llm_int8_linear(x, qw, bias=b, weight_scale=scale)
+    np.testing.assert_allclose(np.asarray(got_llm, np.float32),
+                               np.asarray(got, np.float32))
+    with pytest.raises(ValueError, match="group_size"):
+        quant.weight_only_linear(x, qw, weight_scale=scale, group_size=7)
+    with pytest.raises(ValueError, match="algo"):
+        quant.weight_quantize(w, algo="int3")
+
+
+def test_int8_decode_parity_tiny_llama():
+    """End-to-end: an int8-quantised tiny llama must greedy-decode the
+    same tokens as bf16 for a non-degenerate prompt (serving parity)."""
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    from paddle_tpu.models.quantized import quantize_for_decode
+
+    pt.seed(3)
+    cfg = tiny_llama_config()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = jnp.asarray(np.random.RandomState(5).randint(0, 256, (2, 12)))
+    ref = np.asarray(model.generate(ids, max_new_tokens=8))
+    qmodel = quantize_for_decode(model)
+    got = np.asarray(qmodel.generate(ids, max_new_tokens=8))
+    # int8 weight noise can flip low-margin argmaxes; demand high overlap,
+    # not exactness — and identical shapes
+    assert got.shape == ref.shape
+    agree = (got == ref).mean()
+    assert agree >= 0.85, f"decode agreement {agree}"
+
+
+# ---------------------------------------------------------------------------
+# incubate fusions
+# ---------------------------------------------------------------------------
+
+def test_fused_linear_and_activation():
+    from paddle_tpu.ops import fused_linear, fused_linear_activation
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 5)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(5, 4)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(4,)), jnp.float32)
+    np.testing.assert_allclose(np.asarray(fused_linear(x, w, b)),
+                               np.asarray(x) @ np.asarray(w)
+                               + np.asarray(b), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fused_linear(x, jnp.swapaxes(w, 0, 1), b,
+                                transpose_weight=True)),
+        np.asarray(x) @ np.asarray(w) + np.asarray(b), rtol=1e-5)
+    got = fused_linear_activation(x, w, b, activation="relu")
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.maximum(np.asarray(x) @ np.asarray(w) + np.asarray(b), 0),
+        rtol=1e-5)
+
+
+def test_fused_dropout_add_modes():
+    from paddle_tpu.ops import fused_dropout_add
+
+    x = jnp.ones((64, 64))
+    y = jnp.full((64, 64), 2.0)
+    out = fused_dropout_add(x, y, p=0.0)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    out = fused_dropout_add(x, y, p=0.5, training=False)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    out = fused_dropout_add(x, y, p=0.5, training=False,
+                            mode="downscale_in_infer")
+    np.testing.assert_allclose(np.asarray(out), 2.5)
+    out = np.asarray(fused_dropout_add(x, y, p=0.5))
+    kept = out != 2.0
+    assert 0.3 < kept.mean() < 0.7          # ~half dropped
+    np.testing.assert_allclose(out[kept], 4.0)  # upscaled 1/(1-p)
+
+
+def test_fused_layer_norm_vs_composition():
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.ops import fused_layer_norm
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 6)), jnp.float32)
+    res = jnp.asarray(rng.normal(size=(2, 6)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(6,)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(6,)) + 1, jnp.float32)
+    wb = jnp.asarray(rng.normal(size=(6,)), jnp.float32)
+    got = fused_layer_norm(x, w, wb, 1e-5, residual_alpha=0.7, bias=b,
+                           residual=res)
+    want = F.layer_norm(x + b + 0.7 * res, [6], w, wb, epsilon=1e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5)
+
+
+def test_fused_feedforward_pre_and_post_ln():
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.ops import fused_feedforward
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(2, 3, 8)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(8, 16)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(16, 8)) * 0.1, jnp.float32)
+    ln = jnp.ones((8,), jnp.float32)
+    got = fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                            dropout2_rate=0.0, pre_layer_norm=True,
+                            ln1_scale=ln, activation="gelu")
+    want = x + F.gelu(F.layer_norm(x, [8], ln) @ w1) @ w2
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    got = fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                            dropout2_rate=0.0, pre_layer_norm=False,
+                            ln2_scale=ln)
+    want = F.layer_norm(x + F.relu(x @ w1) @ w2, [8], ln)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_attention_vs_composition():
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.ops import fused_attention
+    from paddle_tpu.ops.attention import flash_attention_reference
+
+    rng = np.random.default_rng(6)
+    b, s, e, nh, hd = 2, 5, 8, 2, 4
+    x = jnp.asarray(rng.normal(size=(b, s, e)), jnp.float32)
+    qkv_w = jnp.asarray(rng.normal(size=(3, nh, hd, e)) * 0.2, jnp.float32)
+    lin_w = jnp.asarray(rng.normal(size=(nh * hd, e)) * 0.2, jnp.float32)
+    ln = jnp.ones((e,), jnp.float32)
+    got = fused_attention(x, qkv_w, lin_w, pre_layer_norm=True,
+                          pre_ln_scale=ln, dropout_rate=0.0,
+                          attn_dropout_rate=0.0)
+    h = F.layer_norm(x, [e], ln)
+    qkv = jnp.einsum("bse,cnhe->cbsnh", h, qkv_w)
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    attn = flash_attention_reference(
+        qkv[0], qkv[1], qkv[2],
+        attn_mask=causal[None, None], return_lse=False)
+    want = x + attn.reshape(b, s, nh * hd) @ lin_w
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_masked_multihead_attention_vs_full_recompute():
+    """MMHA one-step decode == full attention over the tokens seen so far,
+    per batch row at its own cache position."""
+    from paddle_tpu.ops import masked_multihead_attention
+
+    rng = np.random.default_rng(7)
+    b, h, d, max_len = 2, 2, 4, 8
+    lens = np.asarray([2, 5])
+    cache = np.zeros((2, b, h, max_len, d), np.float32)
+    hist = rng.normal(size=(b, h, max_len, d)).astype(np.float32) * 0.5
+    for i, ln_ in enumerate(lens):
+        cache[0, i, :, :ln_] = hist[i, :, :ln_]
+        cache[1, i, :, :ln_] = hist[i, :, :ln_] * 0.3
+    x = rng.normal(size=(b, 3 * h * d)).astype(np.float32)
+    out, new_cache = masked_multihead_attention(
+        jnp.asarray(x), jnp.asarray(cache),
+        sequence_lengths=jnp.asarray(lens, jnp.int32))
+    qkv = x.reshape(b, 3, h, d)
+    for i, ln_ in enumerate(lens):
+        q = qkv[i, 0]                                  # (H, D)
+        ks = np.concatenate([cache[0, i, :, :ln_],
+                             qkv[i, 1][:, None]], 1)   # (H, ln+1, D)
+        vs = np.concatenate([cache[1, i, :, :ln_],
+                             qkv[i, 2][:, None]], 1)
+        sc = np.einsum("hd,hld->hl", q, ks) / np.sqrt(d)
+        w = np.exp(sc - sc.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        want = np.einsum("hl,hld->hd", w, vs).reshape(h * d)
+        np.testing.assert_allclose(np.asarray(out)[i], want, rtol=1e-4,
+                                   atol=1e-5)
+        # cache got the new kv at position lens[i]
+        np.testing.assert_allclose(np.asarray(new_cache)[0, i, :, ln_],
+                                   qkv[i, 1], rtol=1e-6)
+
+
+def test_masked_multihead_attention_rotary():
+    """The rotary path rotates q/k with the provided cos/sin table."""
+    from paddle_tpu.ops import masked_multihead_attention
+
+    rng = np.random.default_rng(8)
+    b, h, d, max_len = 1, 1, 4, 4
+    x = rng.normal(size=(b, 3 * h * d)).astype(np.float32)
+    cache = jnp.zeros((2, b, h, max_len, d), jnp.float32)
+    theta = 0.3
+    rot = np.concatenate([np.full((d // 2,), np.cos(theta)),
+                          np.full((d // 2,), np.sin(theta))])
+    out, _ = masked_multihead_attention(
+        jnp.asarray(x), cache,
+        rotary_tensor=jnp.asarray(rot.reshape(1, 1, 1, d), jnp.float32))
+    # single token attending to itself → output == rotated v? no: == v
+    v = x.reshape(3, d)[2]
+    np.testing.assert_allclose(np.asarray(out)[0], v, rtol=1e-5)

@@ -1,0 +1,79 @@
+"""Docstring-truth lints (round-4 verdict task 7).
+
+Round 2 and round 4 both found docstrings advertising hooks that did not
+exist (a ``FLAGS_*`` name with no flag behind it).  These tests make that
+class of drift a CI failure:
+
+  * every ``FLAGS_<name>`` token in package source must correspond to a
+    flag DEFINEd in :mod:`paddle_tpu.flags` — unless the line explicitly
+    attributes it to the upstream reference ("upstream", "reference", or
+    "paddle/" on the line);
+  * every entry in ``op_registry.KNOWN_SCOPE_LIMITS`` (the visible record
+    of flag-level gaps the name-keyed registry cannot see) must point at
+    a real callable.
+"""
+
+import importlib
+import pathlib
+import re
+
+import paddle_tpu
+from paddle_tpu import flags
+from paddle_tpu.framework.op_registry import KNOWN_SCOPE_LIMITS
+
+PKG = pathlib.Path(paddle_tpu.__file__).parent
+
+_UPSTREAM_MARKERS = ("upstream", "reference", "paddle/", "gflags")
+
+
+def test_every_flags_reference_is_defined():
+    defined = set(flags.get_flags())
+    offenders = []
+    for path in PKG.rglob("*.py"):
+        lines = path.read_text().splitlines()
+        for lineno, line in enumerate(lines, 1):
+            for name in re.findall(r"FLAGS_([a-zA-Z0-9_]+)", line):
+                if name in defined:
+                    continue
+                # sentence context: the line plus its neighbour above
+                # (docstrings wrap mid-sentence)
+                low = (lines[lineno - 2] if lineno >= 2 else "").lower() \
+                    + " " + line.lower()
+                if any(m in low for m in _UPSTREAM_MARKERS):
+                    continue  # describing the upstream's flag, not ours
+                offenders.append(f"{path.relative_to(PKG)}:{lineno}: "
+                                 f"FLAGS_{name} ({line.strip()[:70]})")
+    assert not offenders, (
+        "docstring/comment references a flag that does not exist "
+        "(define it in flags.py or attribute it to the upstream):\n"
+        + "\n".join(offenders))
+
+
+def test_known_scope_limits_resolve():
+    for target in KNOWN_SCOPE_LIMITS:
+        mod_name, attr = target.split(":")
+        mod = importlib.import_module(mod_name)
+        fn = getattr(mod, attr, None)
+        assert callable(fn), f"KNOWN_SCOPE_LIMITS names {target} but it " \
+                             f"does not resolve to a callable"
+
+
+def test_scope_limited_calls_still_raise():
+    """The documented limits must still raise NotImplementedError — if an
+    implementation lands, the entry must be removed (keeps the record
+    honest in both directions)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from paddle_tpu.vision.ops import yolo_box
+
+    with pytest.raises(NotImplementedError, match="scope limit"):
+        yolo_box(jnp.zeros((1, 18, 4, 4)), jnp.asarray([[32, 32]]),
+                 [1, 2, 3, 4], 1, 0.5, 32, iou_aware=True)
+
+    from paddle_tpu.sparse.nn import conv3d
+    from paddle_tpu.tensor.tensor_facade import Tensor
+
+    x = Tensor(jnp.ones((1, 2, 2, 2, 3))).to_sparse_coo(sparse_dim=4)
+    with pytest.raises(NotImplementedError, match="groups"):
+        conv3d(x, jnp.ones((1, 1, 1, 3, 4)), groups=3)
