@@ -238,4 +238,24 @@ def load_state_dict(path: str,
             return _read_region(path, _info, starts, sizes)
 
         out[key] = jax.make_array_from_callback(shape, target, cb)
-    return _unflatten(out)
+    if template is None:
+        return _unflatten(out)
+    # template given: return the TEMPLATE's structure with loaded leaves
+    # substituted.  Structure-only subtrees (e.g. an optimizer's empty
+    # ``master`` dict when no bf16 params need fp32 copies) have no flat
+    # keys, so a plain _unflatten of the loaded dict would DROP them and
+    # the result would no longer match the train step's out_shardings
+    # pytree.  A template array leaf absent from the checkpoint is
+    # corruption — fail loud, never silently keep the fresh value.
+    missing = [k for k, v in (_flatten(template)).items()
+               if k not in out and v is not None]
+    if missing:
+        raise KeyError(f"checkpoint {path} lacks template keys: "
+                       f"{sorted(missing)[:8]}")
+
+    def merge(tmpl, prefix=""):
+        if isinstance(tmpl, dict):
+            return {k: merge(v, f"{prefix}{k}/") for k, v in tmpl.items()}
+        return out.get(prefix[:-1], tmpl)
+
+    return merge(template)

@@ -549,12 +549,18 @@ class Poisson(ExponentialFamily):
         return k * jnp.log(self.rate) - self.rate - jsp.gammaln(k + 1)
 
     def entropy(self):
-        # series expansion matching the reference's implementation level:
-        # exact via expectation over a truncated support window
-        n = jnp.arange(0.0, 64.0)
+        # windowed exact expectation for small rate; Stirling-series
+        # asymptotic for large (a fixed 0..127 window covers rate < 32
+        # to float precision — beyond it the truncation is badly wrong,
+        # so switch forms rather than silently under-count)
+        n = jnp.arange(0.0, 128.0)
         rate = self.rate[..., None]
         lp = n * jnp.log(rate) - rate - jsp.gammaln(n + 1)
-        return -jnp.sum(jnp.exp(lp) * lp, -1)
+        exact = -jnp.sum(jnp.exp(lp) * lp, -1)
+        r = self.rate
+        asym = (0.5 * jnp.log(2 * math.pi * math.e * r)
+                - 1 / (12 * r) - 1 / (24 * r ** 2) - 19 / (360 * r ** 3))
+        return jnp.where(self.rate < 32.0, exact, asym)
 
     @property
     def mean(self):
@@ -1132,7 +1138,15 @@ class TransformedDistribution(Distribution):
     def log_prob(self, value):
         x = self.transform.inverse(value)
         ld = self.transform.forward_log_det_jacobian(x)
-        return self.base.log_prob(x) - ld
+        base_lp = self.base.log_prob(x)
+        # dims the transform PROMOTES to event dims (e.g. StickBreaking /
+        # Softmax over an elementwise base): the base's per-coordinate
+        # densities must collapse to one density per event before the
+        # (already event-summed) log-det is subtracted
+        extra = self.transform._event_dim - len(self.base.event_shape)
+        if extra > 0:
+            base_lp = jnp.sum(base_lp, tuple(range(-extra, 0)))
+        return base_lp - ld
 
 
 class LogNormal(TransformedDistribution):

@@ -74,14 +74,19 @@ _DOMAIN = {
 }
 
 
-def smoke_cases() -> Dict[str, Callable[[], Any]]:
+def smoke_cases(I: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Callable[[], Any]]:
     """'category:name' → zero-arg thunk running one tiny-shape call.
 
     Thunks re-resolve the implementing callable at run time (through
     op_registry.resolve), so a regressed op fails here rather than being
     silently skipped.
+
+    ``I`` overrides the canonical input dict — :func:`run_batched` passes
+    *traced* substitutes so whole groups of thunks stage into one jitted
+    program instead of one eager executable per op.
     """
-    I = _inputs()
+    I = _inputs() if I is None else I
     x, y, m = I["x"], I["y"], I["m"]
     spd, tri, v, vs = I["spd"], I["tri"], I["v"], I["vs"]
     unit, pos, b3, b3t = I["unit"], I["pos"], I["b3"], I["b3t"]
@@ -290,16 +295,15 @@ def _round5_cases(I):
 
     def dist_case(maker, value, discrete=False, has_entropy=True):
         """Construct → sample → log_prob (→ entropy): the whole method
-        surface must lower, not just __init__."""
+        surface must lower, not just __init__.  Every result is returned
+        (the caller's generic block/scalarize consumes them — keeps the
+        thunk traceable for the batched sweep)."""
         def run(cls):
             d = maker(cls)
             s = d.sample((2,), key=key)
-            jax.block_until_ready(s)
             lp = d.log_prob(value)
-            jax.block_until_ready(lp)
-            if has_entropy:
-                jax.block_until_ready(d.entropy())
-            return s, lp
+            ent = d.entropy() if has_entropy else None
+            return s, lp, ent
         return run
 
     half = jnp.asarray(0.4, jnp.float32)
@@ -312,13 +316,12 @@ def _round5_cases(I):
         def run(cls):
             t = maker(cls)
             y = t.forward(value)
-            jax.block_until_ready(y)
-            jax.block_until_ready(t.inverse(y))
+            inv = t.inverse(y)
             try:
-                jax.block_until_ready(t.forward_log_det_jacobian(value))
+                ld = t.forward_log_det_jacobian(value)
             except NotImplementedError:
-                pass  # non-bijective convention transforms (Softmax)
-            return y
+                ld = None  # non-bijective convention transforms (Softmax)
+            return y, inv, ld
         return run
 
     def kl_case(f):
@@ -350,8 +353,7 @@ def _round5_cases(I):
                 return 2 * g
 
         out = Double.apply(x)
-        jax.block_until_ready(out)
-        return jax.grad(lambda a: jnp.sum(Double.apply(a)))(x)
+        return out, jax.grad(lambda a: jnp.sum(Double.apply(a)))(x)
 
     def quant_roundtrip(algo):
         def run(f):
@@ -564,7 +566,6 @@ def _mmha_case(f):
     cache = jnp.zeros((2, b, h, max_len, d), jnp.float32)
     out, cache = f(x, cache,
                    sequence_lengths=jnp.asarray([0, 3], jnp.int32))
-    jax.block_until_ready(out)
     return out
 
 
@@ -1139,6 +1140,118 @@ def run(names: Optional[List[str]] = None) -> Dict[str, str]:
             continue
         except Exception as e:  # noqa: BLE001 — report, don't mask, per-op
             failures[key] = f"{type(e).__name__}: {e}"
+    return failures
+
+
+def _scalarize(out) -> Any:
+    """Collapse a thunk's output pytree to one fp32 scalar (the group
+    programs' single fetched value — every op's result feeds it, so
+    nothing is dead-code-eliminated)."""
+    total = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(out):
+        if isinstance(leaf, jax.Array) or isinstance(leaf, jnp.ndarray):
+            a = leaf
+            if jnp.issubdtype(a.dtype, jnp.complexfloating):
+                a = jnp.abs(a)
+            elif not jnp.issubdtype(a.dtype, jnp.floating):
+                a = a.astype(jnp.float32)
+            total = total + jnp.sum(a.astype(jnp.float32))
+    return total
+
+
+# categories whose thunks are host-side by nature (python loops over
+# concrete floats, numpy metric accumulation, facade attribute probing,
+# context managers asserting concrete dtypes) — sent straight to the
+# per-op eager path instead of wasting a group bisection on them
+_EAGER_CATEGORIES = {"paddle.optimizer", "paddle.optimizer.lr",
+                     "paddle.metric", "paddle.amp", "paddle.Tensor"}
+
+
+def run_batched(names: Optional[List[str]] = None,
+                group_size: int = 32,
+                verbose: bool = False) -> Dict[str, str]:
+    """The sweep, restructured for a high-RTT chip (round-4 verdict #2).
+
+    :func:`run` executes one eager thunk per op — on the tunnel chip that
+    is a per-op executable compile + RPC (~2-3 s each, the 33-minute
+    lane).  Here the canonical input arrays become *jit arguments*: each
+    group of ``group_size`` thunks is rebuilt around the traced
+    substitutes (``smoke_cases(I_traced)``) inside ONE jitted program
+    whose single scalar output (every op's result folded in — nothing
+    DCE-able) is the only fetch.  One compile + one RPC per group.
+
+    A group that fails to trace/compile/run is bisected: halves retry as
+    smaller programs, singletons fall back to the eager path — so error
+    attribution is exactly :func:`run`'s.  Host-logic categories
+    (optimizer/metric/amp/Tensor) skip straight to eager.  Ops whose
+    thunks build their own inputs (creation ops) execute eagerly at trace
+    time inside the group — they still ride the group's single fetch.
+    Same contract as :func:`run`."""
+    I0 = _inputs()
+    arr_keys = sorted(k for k, v in I0.items()
+                      if isinstance(v, jax.Array))
+    table = op_registry.resolve()
+    failures: Dict[str, str] = {}
+
+    all_keys = [k for k in smoke_cases(I0)
+                if names is None or k in names]
+    batch_keys: List[str] = []
+    eager_keys: List[str] = []
+    for key in all_keys:
+        cat, name = key.split(":", 1)
+        if cat in _EAGER_CATEGORIES:
+            eager_keys.append(key)
+        elif table.get(cat, {}).get(name) is None:
+            continue                      # declared-absent: skip, as run()
+        else:
+            batch_keys.append(key)
+
+    def group_program(arrs, keys):
+        I_t = dict(I0)
+        I_t.update(zip(arr_keys, arrs))
+        cases_t = smoke_cases(I_t)
+        total = jnp.float32(0.0)
+        for k in keys:
+            total = total + _scalarize(cases_t[k]())
+        return total
+
+    arrs0 = [I0[k] for k in arr_keys]
+
+    from . import random as _frandom
+
+    def run_group(keys):
+        if not keys:
+            return
+        # thunks may reseed the global RNG chain (pt.seed inside the nn
+        # Layer cases); under a group TRACE that stores a traced key into
+        # the global — a leaked tracer poisoning every later eager thunk.
+        # Snapshot/restore the chain around each group attempt.
+        g = _frandom._globals()
+        saved = (g.key, g.counter, g.guard)
+        try:
+            prog = jax.jit(lambda arrs: group_program(arrs, tuple(keys)))
+            val = float(prog(arrs0))
+            if verbose:
+                print(f"group of {len(keys)}: ok (scalar {val:.3g})")
+        except Exception:  # noqa: BLE001 — bisect down to the culprit
+            if len(keys) == 1:
+                eager_keys.append(keys[0])
+            else:
+                mid = len(keys) // 2
+                run_group(keys[:mid])
+                run_group(keys[mid:])
+        finally:
+            g.key, g.counter, g.guard = saved
+
+    for i in range(0, len(batch_keys), group_size):
+        run_group(batch_keys[i:i + group_size])
+
+    if eager_keys:
+        failures.update(run(names=eager_keys))
+    if verbose:
+        print(f"batched sweep: {len(batch_keys)} batch-eligible in "
+              f"{(len(batch_keys) + group_size - 1) // group_size} "
+              f"groups, {len(eager_keys)} eager, {len(failures)} failed")
     return failures
 
 

@@ -78,10 +78,27 @@ def _place_on_mesh(model, params, cache, input_ids):
         return NamedSharding(mesh, P(*_filter_spec(entries, names)))
 
     specs = model.param_shardings(include_buffers=True)
-    params = {
-        k: jax.device_put(v, NamedSharding(
-            mesh, P(*_filter_spec(tuple(specs.get(k) or P()), names))))
-        for k, v in params.items()}
+
+    # path-wise lookup: plain models carry a flat {name: spec} dict; a
+    # quantized wrapper's packed {"fp"/"qw"/"qs": {name: spec}} store
+    # nests one level — walking the value tree's own path keeps TP/FSDP
+    # layouts instead of silently replicating everything whose top-level
+    # key has no spec
+    def _lookup(path):
+        node = specs
+        for p in path:
+            key = getattr(p, "key", None)
+            if isinstance(node, dict) and key in node:
+                node = node[key]
+            else:
+                return None
+        return None if isinstance(node, dict) else node
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    params = jax.tree_util.tree_unflatten(treedef, [
+        jax.device_put(v, NamedSharding(
+            mesh, P(*_filter_spec(tuple(_lookup(path) or P()), names))))
+        for path, v in flat])
     batch = tuple(a for a in ("dp", "sharding") if a in names)
     input_ids = jax.device_put(input_ids, ns(batch))
     if isinstance(cache, jax.Array) and cache.ndim == 6:

@@ -38,6 +38,13 @@ class QuantizedForDecode:
 
     def __init__(self, model, algo: str = "weight_only_int8",
                  min_elems: int = 65536):
+        if algo != "weight_only_int8":
+            # fail BEFORE the quantization pass: int4 decode would need a
+            # per-weight unpack shim in _prepare_params; int8 is the
+            # measured serving configuration (BENCH_DECODE.json)
+            raise NotImplementedError(
+                f"decode wrapper supports weight_only_int8 only, "
+                f"got {algo!r}")
         self.unwrapped = model
         self.config = model.config
         self.algo = algo
@@ -66,15 +73,22 @@ class QuantizedForDecode:
         dt = to_jax_dtype(self.config.dtype)
         deq = {k: (w.astype(dt) * packed["qs"][k].astype(dt))
                for k, w in packed["qw"].items()}
-        if self.algo == "weight_only_int4":
-            raise NotImplementedError(
-                "int4 decode needs the unpack shim; int8 is the measured "
-                "serving configuration")
         return {**packed["fp"], **deq}
 
     def param_shardings(self, include_buffers: bool = True):
-        return self.unwrapped.param_shardings(
+        """Specs congruent with the PACKED state_dict: quantized weights
+        keep their original TP/FSDP layout (same (K, N) shape), the (N,)
+        scales take the weight spec's output-axis entry, fp leftovers
+        keep their own specs."""
+        from jax.sharding import PartitionSpec as P
+
+        inner = self.unwrapped.param_shardings(
             include_buffers=include_buffers)
+        wspec = {k: inner.get(k) or P() for k in self._qw}
+        return {"fp": {k: inner.get(k) or P() for k in self._fp},
+                "qw": dict(wspec),
+                "qs": {k: P(tuple(wspec[k])[-1] if len(tuple(wspec[k]))
+                            else None) for k in self._qs}}
 
     # -- model surface ----------------------------------------------------
     def decode_step(self, input_ids, cache, pos, **kw):

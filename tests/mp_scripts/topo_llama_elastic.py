@@ -1,0 +1,112 @@
+"""Topology-elastic llama resume (round-4 verdict task 8): a tiny llama
+trains on a 2-axis dp×sharding mesh with ZeRO-sharded optimizer state and
+per-step distributed checkpoints; the job crashes once and resumes under a
+DIFFERENT world size (2 procs × 2 devices, dp=2×sharding=2 → 1 proc × 2
+devices, dp=1×sharding=2).  ``load_state_dict(template=...)`` reshards
+params AND optimizer moments onto the new mesh.
+
+Every incarnation appends "LOSS <step> <value>" lines; the test asserts
+the resumed curve continues the crashed one exactly against an uncrashed
+reference run — loss-curve continuity through a topology change, not just
+a counter.  Data and step RNG are step-keyed, so the curve is a pure
+function of (init seed, step) whatever the mesh.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from jax.experimental import multihost_utils
+
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.optimizer import AdamW
+
+TOTAL_STEPS = 4
+GLOBAL_ROWS = 8
+
+
+def latest_step(workdir):
+    marker = os.path.join(workdir, "latest.txt")
+    if not os.path.exists(marker):
+        return None
+    with open(marker) as f:
+        return int(f.read().strip())
+
+
+def batch_for(step, vocab, hcg):
+    ids = np.random.RandomState(1000 + step).randint(
+        0, vocab, (GLOBAL_ROWS, 17))
+    return dist.shard_batch({"input_ids": jnp.asarray(ids[:, :-1]),
+                             "labels": jnp.asarray(ids[:, 1:])}, hcg)
+
+
+def main():
+    workdir = sys.argv[1]
+    os.makedirs(workdir, exist_ok=True)
+    crash_step = int(sys.argv[2]) if len(sys.argv) > 2 else -1
+    restart = int(os.environ.get("PADDLE_TPU_RESTART_NUM", "0"))
+    # 2-axis mesh: sharding fixed at 2 (ZeRO shards survive the resize),
+    # dp absorbs whatever the incarnation's world provides
+    hcg = dist.init_parallel_env(sharding_degree=2)
+    proc = jax.process_index()
+    world = jax.process_count()
+
+    pt.seed(0)                       # same init whatever the topology
+    cfg = tiny_llama_config()
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-2)
+    step_fn, params, opt_state = dist.build_train_step(
+        model, opt, hcg=hcg, zero_stage=2, donate=False)
+
+    last = latest_step(workdir)
+    start = 0
+    if last is not None:
+        start = last + 1
+        # reshard-on-load: the checkpoint was written over a different
+        # mesh/world; the freshly-built (params, opt_state) are the
+        # template carrying the NEW mesh's shardings
+        state = ckpt.load_state_dict(
+            os.path.join(workdir, f"step{last}"),
+            template={"params": params, "opt": opt_state})
+        params, opt_state = state["params"], state["opt"]
+
+    loss_log = os.path.join(workdir, f"losses.r{restart}.p{proc}.txt")
+    for step in range(start, TOTAL_STEPS):
+        loss, params, opt_state = step_fn(
+            params, opt_state, batch_for(step, cfg.vocab_size, hcg),
+            jax.random.fold_in(jax.random.key(99), step))
+        loss = float(jax.block_until_ready(loss))
+        with open(loss_log, "a") as f:
+            f.write(f"LOSS {step} {loss:.6f}\n")
+        ckpt.save_state_dict({"params": params, "opt": opt_state},
+                             os.path.join(workdir, f"step{step}"))
+        multihost_utils.sync_global_devices(f"step{step}")
+        if proc == 0:
+            tmp = os.path.join(workdir, "latest.txt.tmp")
+            with open(tmp, "w") as f:
+                f.write(str(step))
+            os.replace(tmp, os.path.join(workdir, "latest.txt"))
+        multihost_utils.sync_global_devices(f"step{step}_marked")
+        if restart == 0 and step == crash_step and proc == world - 1:
+            os._exit(17)             # host loss after step's checkpoint
+
+    print(f"DONE start={start} world={world} proc={proc} "
+          f"dp={hcg.get_data_parallel_world_size()} "
+          f"sharding={hcg.get_sharding_parallel_world_size()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

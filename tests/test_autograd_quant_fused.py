@@ -318,3 +318,34 @@ def test_masked_multihead_attention_rotary():
     # single token attending to itself → output == rotated v? no: == v
     v = x.reshape(3, d)[2]
     np.testing.assert_allclose(np.asarray(out)[0], v, rtol=1e-5)
+
+
+def test_quantized_decode_keeps_mesh_shardings():
+    """On a hybrid mesh the packed int8 store must keep the wrapped
+    model's TP/FSDP layouts — the packed-tree spec lookup, not silent
+    replication (which would defeat the capacity win)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    from paddle_tpu.models.quantized import quantize_for_decode
+
+    pt.seed(4)
+    model = LlamaForCausalLM(tiny_llama_config())
+    model.eval()
+    ids = jnp.asarray(np.random.RandomState(5).randint(0, 256, (4, 8)))
+    ref = np.asarray(model.generate(ids, max_new_tokens=4))
+
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, mp_degree=2,
+                                      devices=jax.devices()[:4])
+    dist.set_hybrid_group(hcg)
+    try:
+        qmodel = quantize_for_decode(model, min_elems=0)
+        specs = qmodel.param_shardings()
+        assert set(specs) == {"fp", "qw", "qs"}
+        # at least one quantized weight keeps an mp-sharded axis
+        assert any("mp" in tuple(s) for s in specs["qw"].values()), specs
+        got = np.asarray(qmodel.generate(ids, max_new_tokens=4))
+        assert got.shape == ref.shape
+        agree = (got == ref).mean()
+        assert agree >= 0.8, agree
+    finally:
+        dist.set_hybrid_group(None)

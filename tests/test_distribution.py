@@ -323,3 +323,31 @@ def test_distribution_surface_traces_under_jit():
 
     out = f(KEY, jnp.asarray(2.0))
     assert np.isfinite(float(out))
+
+
+def test_transformed_distribution_event_promoting_transform():
+    """A transform that promotes batch dims to event dims (StickBreaking
+    over an elementwise Normal) must return ONE density per event —
+    base log_prob summed over the promoted dims before the log-det."""
+    base = D.Normal(jnp.zeros(3), jnp.ones(3))
+    td = D.TransformedDistribution(base, [D.StickBreakingTransform()])
+    assert td.event_shape == (4,)
+    s = td.sample((5,), key=KEY)
+    lp = td.log_prob(s)
+    assert lp.shape == (5,), lp.shape
+    # cross-check against the change-of-variables identity at one point
+    x = jnp.asarray([0.3, -0.2, 0.5])
+    t = D.StickBreakingTransform()
+    want = (jnp.sum(base.log_prob(x))
+            - t.forward_log_det_jacobian(x))
+    np.testing.assert_allclose(float(td.log_prob(t.forward(x))),
+                               float(want), rtol=1e-5)
+
+
+def test_poisson_entropy_large_rate():
+    """The truncated-window form must switch to the asymptotic series
+    for large rate (a fixed window under-counts catastrophically)."""
+    for rate in (3.0, 20.0, 50.0, 100.0, 400.0):
+        got = float(D.Poisson(rate).entropy())
+        want = float(st.poisson(rate).entropy())
+        np.testing.assert_allclose(got, want, rtol=1e-3), rate

@@ -110,3 +110,54 @@ def test_topology_elastic_resume_scale_out(tmp_path):
     done = [l for l in logs.values() if "DONE" in l]
     assert len(done) == 2, logs
     assert all("start=2" in l and "world=2" in l for l in done), logs
+
+
+@pytest.mark.timeout(600)
+def test_topology_elastic_llama_loss_continuity(tmp_path):
+    """Round-4 verdict task 8: a tiny llama on a 2-axis dp×sharding mesh
+    (2 procs × 2 devices = dp2×sh2) crashes after step 1 and resumes on
+    ONE process (dp1×sh2) — ZeRO-sharded optimizer moments genuinely
+    reshard on load, and the loss curve continues exactly: the resumed
+    steps match an uncrashed reference run to float tolerance."""
+
+    def losses_from(workdir):
+        vals = {}
+        for f in glob.glob(os.path.join(str(workdir), "losses.*.txt")):
+            for line in open(f):
+                _, s, v = line.split()
+                vals[int(s)] = float(v)
+        return vals
+
+    # reference: same job, no crash, 2 procs throughout
+    ref_logs = str(tmp_path / "ref_logs")
+    cfg = LaunchConfig(nprocs=2, backend="cpu", devices_per_proc=2,
+                       log_dir=ref_logs)
+    rc = elastic_run(
+        [sys.executable, "-u",
+         os.path.join(SCRIPTS, "topo_llama_elastic.py"),
+         str(tmp_path / "ref_work")], cfg)
+    assert rc == 0, _read_logs(ref_logs)
+    ref = losses_from(tmp_path / "ref_work")
+    assert sorted(ref) == [0, 1, 2, 3], ref
+
+    # elastic: crash after step 1's checkpoint, resume at dp1×sh2
+    el_logs = str(tmp_path / "el_logs")
+    cfg = LaunchConfig(nprocs=2, backend="cpu", devices_per_proc=2,
+                       log_dir=el_logs, max_restarts=1, restart_nprocs=[1])
+    rc = elastic_run(
+        [sys.executable, "-u",
+         os.path.join(SCRIPTS, "topo_llama_elastic.py"),
+         str(tmp_path / "el_work"), "1"], cfg)
+    logs = _read_logs(el_logs)
+    assert rc == 0, f"elastic llama job failed:\n{logs}"
+    done = [l for l in logs.values() if "DONE" in l]
+    assert len(done) == 1 and "start=2" in done[0], logs
+    assert "dp=1 sharding=2" in done[0], logs
+
+    got = losses_from(tmp_path / "el_work")
+    assert sorted(got) == [0, 1, 2, 3], got
+    for s in range(4):
+        assert abs(got[s] - ref[s]) < 2e-4, (s, got[s], ref[s], got, ref)
+    # a real train step, not a frozen counter: the curve moves (fresh
+    # random tokens each step — no monotonicity to demand in 4 steps)
+    assert len({round(v, 5) for v in got.values()}) > 1, got

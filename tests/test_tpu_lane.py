@@ -176,9 +176,14 @@ def test_eig_on_device_arrays():
 # ---------------------------------------------------------------------------
 
 def test_registry_sweep_on_chip():
+    """Batched form (round-4 verdict #2): grouped jitted programs cut the
+    sweep from ~30 min of per-op eager compiles to minutes; error
+    attribution falls back per-op via bisection (see
+    op_smoke.run_batched).  ``python bench.py`` embeds this same sweep's
+    result in its driver-captured JSON."""
     from paddle_tpu.framework import op_smoke
 
-    failures = op_smoke.run()
+    failures = op_smoke.run_batched()
     assert not failures, (
         f"{len(failures)} registry ops fail on the real chip:\n"
         + "\n".join(f"  {k}: {v[:160]}" for k, v in sorted(failures.items())))
