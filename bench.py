@@ -682,6 +682,69 @@ def _fused_vs_stack(batch=1, prompt=8, max_len=1024, t1=8, t2=72,
             "fused_over_stack": round(per_stack / per_fused, 3)}
 
 
+def _serving_bench(model, on_tpu):
+    """Continuous-batching engine under a Poisson-ish synthetic arrival
+    trace (paddle_tpu/serving): exponential inter-arrival gaps measured
+    in scheduler ticks, mixed prompt/output lengths, fixed seed.  The
+    whole trace runs twice through the SAME engine — the first pass pays
+    every compile (one step program + one prefill program per prompt
+    bucket), the second is the steady-state measurement.  Reported:
+    wall tokens/s of the timed pass, mean slot occupancy (the quantity
+    continuous batching exists to maximise), and the engine's own trace
+    counters proving the step function compiled once."""
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+
+    if on_tpu:
+        slots, max_len, n_req = 8, 2048, 48
+        plo, phi, nlo, nhi, mean_gap = 32, 256, 32, 128, 2.0
+    else:  # plumbing smoke: tiny trace, no perf meaning
+        slots, max_len, n_req = 4, 128, 12
+        plo, phi, nlo, nhi, mean_gap = 4, 24, 4, 12, 2.0
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    prompts = [rng.randint(0, vocab, rng.randint(plo, phi + 1))
+               .astype(np.int32) for _ in range(n_req)]
+    news = rng.randint(nlo, nhi + 1, n_req)
+    arrivals = np.cumsum(rng.exponential(mean_gap, n_req)).astype(int)
+    eng = ServingEngine(model, num_slots=slots, max_length=max_len)
+
+    def run_trace():
+        rids, occ, t = [], [], 0
+        n_sub = 0
+        while n_sub < n_req or eng.num_active or eng.queue_depth:
+            while n_sub < n_req and arrivals[n_sub] <= t:
+                rids.append(eng.submit(prompts[n_sub],
+                                       max_new_tokens=int(news[n_sub])))
+                n_sub += 1
+            eng.step()
+            occ.append(eng.last_occupancy)
+            t += 1
+        return rids, occ
+
+    run_trace()                                    # compile + warm
+    t0 = time.perf_counter()
+    rids, occ = run_trace()                        # steady-state pass
+    wall = time.perf_counter() - t0
+    toks = sum(len(eng.result(r)) for r in rids)
+    return {"num_slots": slots, "max_length": max_len,
+            "requests": n_req,
+            "prompt_len_range": [plo, phi],
+            "new_tokens_range": [nlo, nhi],
+            "arrival": f"exponential inter-arrival, mean {mean_gap} "
+                       f"ticks, fixed seed",
+            "wall_s": round(wall, 4),
+            "generated_tokens": int(toks),
+            "tokens_per_sec": round(toks / wall, 1),
+            "mean_slot_occupancy": round(float(np.mean(occ)) / slots, 3),
+            "step_traces": eng.step_traces,
+            "prefill_traces": eng.prefill_traces,
+            "note": "second pass through a warm engine; occupancy is "
+                    "busy slots / num_slots averaged over ticks "
+                    "(idle arrival gaps included)"}
+
+
 def _merge_decode_artifact(section_key, section):
     """Incremental write: each finished section lands on disk immediately,
     so a wedged later section (tunnel RPC hangs are real — round 5) never
@@ -735,7 +798,7 @@ def run_decode_bench(args):
     # a 2 GB model build it never uses
     model = params = None
     n = pbytes = 0
-    if want & {"prefill", "decode", "int8", "e2e"}:
+    if want & {"prefill", "decode", "int8", "e2e", "serving"}:
         model, params, n = _decode_model(max_pos=8192 if on_tpu else 512,
                                          on_tpu=on_tpu)
         pbytes = n * 2                                  # bf16 weights
@@ -858,6 +921,15 @@ def run_decode_bench(args):
                     "tunnel RTT — the user-visible latency; the in-graph "
                     "decode rows are the chip-side truth"}})
         print(f"generate e2e: {e2e:.3f} s", file=sys.stderr)
+
+    # -- continuous-batching serving engine ------------------------------
+    if "serving" in want:
+        print("[decode-bench] serving engine trace ...", file=sys.stderr)
+        sv = _serving_bench(model, on_tpu)
+        _merge_decode_artifact(skey, {"serving": sv})
+        print(f"serving: {sv['tokens_per_sec']} tok/s, occupancy "
+              f"{sv['mean_slot_occupancy']}, step_traces "
+              f"{sv['step_traces']}", file=sys.stderr)
 
     # -- fused_multi_transformer vs per-layer stack ----------------------
     if "fused" in want:
@@ -988,8 +1060,10 @@ def main():
                          "tokens/sec + fused_multi_transformer vs stack "
                          "into BENCH_DECODE.json")
     ap.add_argument("--sections", default=None,
-                    help="comma list for --decode: prefill,decode,int8,"
-                         "e2e,fused (default all)")
+                    help="comma list for the decode/serving harness: "
+                         "prefill,decode,int8,e2e,fused (default all) "
+                         "plus the opt-in continuous-batching 'serving' "
+                         "trace; implies --decode")
     ap.add_argument("--no-lane", action="store_true", dest="no_lane",
                     help="skip the embedded tpu_lane correctness summary "
                          "(quick local bench runs)")
@@ -1005,7 +1079,7 @@ def main():
         run_op_bench(args)
         return
 
-    if args.decode:
+    if args.decode or args.sections:
         run_decode_bench(args)
         return
 

@@ -6,6 +6,10 @@ layout: paddle/fluid/inference/api/ — ``paddle_infer::Config`` +
 The engine itself is XLA: the analysis passes / TensorRT subgraphing the
 reference runs at load time are what XLA already did at export time, so the
 Predictor is a thin runner over a :mod:`paddle_tpu.jit` artifact.
+
+Online LLM serving (staggered arrivals, mixed lengths) goes through the
+continuous-batching :class:`~paddle_tpu.serving.ServingEngine`,
+re-exported here as part of the deployment surface.
 """
 
 from __future__ import annotations
@@ -16,8 +20,10 @@ import jax
 import numpy as np
 
 from .. import jit as _jit
+from ..serving import Request, SamplingParams, ServingEngine
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor",
+           "ServingEngine", "SamplingParams", "Request"]
 
 
 class Config:

@@ -6,7 +6,8 @@ measured on (BASELINE.md) and they double as integration tests of the hybrid
 parallel stack.
 """
 
-from .generation import DecodeStep, greedy_generate, init_kv_cache
+from .generation import (DecodeStep, greedy_generate, init_kv_cache,
+                         sample_tokens)
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     causal_lm_loss, llama3_8b_config, llama_pipe_descs,
                     tiny_llama_config)
@@ -14,5 +15,5 @@ from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
 __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama3_8b_config",
     "tiny_llama_config", "llama_pipe_descs", "causal_lm_loss",
-    "DecodeStep", "greedy_generate", "init_kv_cache",
+    "DecodeStep", "greedy_generate", "init_kv_cache", "sample_tokens",
 ]

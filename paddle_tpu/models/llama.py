@@ -201,19 +201,36 @@ class LlamaAttention(Layer):
             grouped GQA, bf16 operands, fp32 accumulation, no K/V
             expansion.
 
+        ``pos`` may also be an int (B,) vector of PER-ROW positions — the
+        serving engine's slot batch, every row a different request at a
+        different depth.  The write becomes a batched scatter (row i at
+        column pos[i]) and the cache mask compares against the row's own
+        position vector; the scalar paths are untouched.
+
         x: (B, s, H*D).  Returns (out, cache).
         """
         from ..ops.attention import cached_decode_attention
 
         b, s, _ = x.shape
-        position_ids = pos + jnp.arange(s)[None, :]
+        per_row = getattr(pos, "ndim", 0) == 1
+        if per_row:
+            position_ids = pos[:, None] + jnp.arange(s)[None, :]  # (B, s)
+        else:
+            position_ids = pos + jnp.arange(s)[None, :]
         q, k, v = self._qkv(x, rope_cache, position_ids)
-        cache = jax.lax.dynamic_update_slice(
-            cache, k.astype(cache.dtype)[None, None],
-            (idx, 0, 0, pos, 0, 0))
-        cache = jax.lax.dynamic_update_slice(
-            cache, v.astype(cache.dtype)[None, None],
-            (idx, 1, 0, pos, 0, 0))
+        if per_row:
+            rows = jnp.arange(b)[:, None]                          # (B, 1)
+            cache = cache.at[idx, 0, rows, position_ids].set(
+                k.astype(cache.dtype))
+            cache = cache.at[idx, 1, rows, position_ids].set(
+                v.astype(cache.dtype))
+        else:
+            cache = jax.lax.dynamic_update_slice(
+                cache, k.astype(cache.dtype)[None, None],
+                (idx, 0, 0, pos, 0, 0))
+            cache = jax.lax.dynamic_update_slice(
+                cache, v.astype(cache.dtype)[None, None],
+                (idx, 1, 0, pos, 0, 0))
         q = constrain(q, ("dp", "sharding"), None, "mp", None)
         cache = constrain(cache, None, None, ("dp", "sharding"), None,
                           "mp", None)

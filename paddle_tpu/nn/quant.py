@@ -36,12 +36,16 @@ def weight_quantize(x, algo: str = "weight_only_int8"):
     """
     x = jnp.asarray(x)
     scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=0)
+    # an all-zero output column has scale 0: 0/0 would quantize to NaN →
+    # int8 garbage; divide by 1 instead (q = 0, scale stays 0, dequant
+    # reconstructs exact zeros)
+    safe = jnp.where(scale == 0.0, 1.0, scale)
     if algo == "weight_only_int8":
-        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale * 127.0),
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe * 127.0),
                      -127, 127).astype(jnp.int8)
         return q, scale / 127.0
     if algo == "weight_only_int4":
-        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale * 7.0),
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe * 7.0),
                      -7, 7).astype(jnp.int8)
         if q.shape[0] % 2:
             q = jnp.pad(q, ((0, 1), (0, 0)))
@@ -80,10 +84,30 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     ``weight``: int8 (K, N) or int4-packed (K/2, N); ``weight_scale``:
     (N,) from :func:`weight_quantize`.  ``group_size`` is accepted for
     signature parity (per-channel scales only — the serving-measured
-    configuration)."""
+    configuration).
+
+    On Pallas-capable backends, decode-shaped int8 calls (rows ≤ 256,
+    K/N multiples of 128) route through the in-kernel-dequant matmul
+    (ops/pallas/int8_matmul.py) so HBM streams int8 bytes — the XLA
+    composition below hoists a dequantised bf16 copy out of decode scans
+    (measured: BENCH_DECODE.json ``int8_decode``), which is exactly the
+    bandwidth this kernel recovers.  Ineligible shapes fall back."""
     if group_size not in (-1, 64, 128):
         raise ValueError("group_size must be -1/64/128")
     w = weight
+    if (weight_dtype == "int8" and weight_scale is not None
+            and w.ndim == 2 and w.dtype == jnp.int8
+            and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)):
+        from ..ops import _dispatch
+        if _dispatch.use_pallas():
+            try:
+                from ..ops.pallas.int8_matmul import int8_matmul_pallas
+                y = int8_matmul_pallas(
+                    x, w, weight_scale,
+                    interpret=_dispatch.pallas_interpret())
+                return y if bias is None else y + bias
+            except NotImplementedError:
+                pass                       # shape-ineligible → XLA path
     if weight_dtype == "int4":
         w = _unpack_int4(w, x.shape[-1])
     compute = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) \

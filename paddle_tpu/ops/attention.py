@@ -123,7 +123,10 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
 
     q: (B, s, Hq, D) — the new tokens (s is 1 in steady-state decode);
     k_cache/v_cache: (B, L, Hkv, D) with the new K/V already written at
-    ``pos..pos+s``; slots ``> pos+i`` are masked.
+    ``pos..pos+s``; slots ``> pos+i`` are masked.  ``pos`` is a scalar
+    (whole-batch decode, the ``generate()`` path) or an int (B,) vector of
+    per-row positions (the serving engine's slot batch, where every row
+    is a different request at a different depth).
 
     Decode is HBM-bound, so this path is shaped around traffic, where the
     generic ``flash_attention_reference`` (a training oracle) is not:
@@ -150,9 +153,14 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
     scores = jnp.einsum("bskgd,blkd->bkgsl", qg, k_cache,
                         preferred_element_type=jnp.float32)
     scores = scores * jnp.float32(scale)
-    qi = pos + jnp.arange(s)[:, None]                 # (s, 1)
-    kj = jnp.arange(L)[None, :]                       # (1, L)
-    keep = (kj <= qi)[None, None, None]               # (1,1,1,s,L)
+    kj = jnp.arange(L)
+    if getattr(pos, "ndim", 0) == 1:                  # per-row positions
+        qi = pos[:, None] + jnp.arange(s)[None, :]    # (B, s)
+        keep = (kj[None, None] <= qi[:, :, None])     # (B, s, L)
+        keep = keep[:, None, None]                    # (B,1,1,s,L)
+    else:
+        qi = pos + jnp.arange(s)[:, None]             # (s, 1)
+        keep = (kj[None] <= qi)[None, None, None]     # (1,1,1,s,L)
     if extra_mask is not None:
         # bool; (B, L) key-padding form, or rank-3 broadcastable to
         # (B, s, L) — lifted into the (B, Hkv, G, s, L) layout
@@ -169,10 +177,14 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
 def cache_mask(pos, q_len: int, kv_len: int):
     """Bool (1, 1, q_len, kv_len) mask for attention over a pre-allocated
     KV cache: query i (global position pos+i) may attend cache slot j iff
-    j <= pos+i (causal + don't read the uninitialised tail)."""
+    j <= pos+i (causal + don't read the uninitialised tail).  A (B,)
+    ``pos`` vector (per-row slot positions) yields (B, 1, q_len, kv_len)."""
+    kj = jnp.arange(kv_len)
+    if getattr(pos, "ndim", 0) == 1:
+        qi = pos[:, None] + jnp.arange(q_len)[None, :]      # (B, q)
+        return (kj[None, None] <= qi[:, :, None])[:, None]  # (B,1,q,kv)
     qi = pos + jnp.arange(q_len)[:, None]
-    kj = jnp.arange(kv_len)[None, :]
-    return (kj <= qi)[None, None]
+    return (kj[None] <= qi)[None, None]
 
 
 def segment_mask(q_segment_ids, kv_segment_ids):

@@ -1,0 +1,16 @@
+"""paddle_tpu.serving — continuous-batching inference runtime.
+
+The whole-scan ``generate()`` path (models/generation.py) is the parity
+benchmark: one compiled program per static (batch, prompt, max_new_tokens)
+config, every row entering and leaving together.  Serving traffic is the
+opposite shape — staggered arrivals, mixed lengths — and BENCH_DECODE.json
+shows per-step decode already runs at the weight-stream bound, so the
+remaining throughput lever is keeping batch slots FULL.  This package is
+the Orca-style engine that does that: a fixed-slot KV cache, a step-level
+decode function compiled exactly once, and a host-side scheduler that
+admits queued requests into freed slots mid-flight.
+"""
+
+from .engine import Request, SamplingParams, ServingEngine
+
+__all__ = ["ServingEngine", "SamplingParams", "Request"]

@@ -137,6 +137,75 @@ def test_weight_only_linear_parity():
         quant.weight_quantize(w, algo="int3")
 
 
+def test_weight_quantize_zero_column_no_nan():
+    """An all-zero output column has absmax scale 0 — 0/0 used to quantize
+    to NaN garbage.  It must quantize to exact zeros (scale 0) and survive
+    the whole linear path."""
+    rng = np.random.default_rng(7)
+    w = np.asarray(rng.normal(size=(32, 8)), np.float32)
+    w[:, 3] = 0.0
+    for algo, k in (("weight_only_int8", 32), ("weight_only_int4", 32)):
+        qw, scale = quant.weight_quantize(jnp.asarray(w), algo=algo)
+        assert np.isfinite(np.asarray(scale)).all()
+        assert float(scale[3]) == 0.0
+        back = np.asarray(quant.weight_dequantize(
+            qw, scale, algo=algo, out_dtype=jnp.float32, k=k))
+        assert np.isfinite(back).all()
+        np.testing.assert_array_equal(back[:, 3], 0.0)
+    qw, scale = quant.weight_quantize(jnp.asarray(w))
+    assert not np.any(np.asarray(qw)[:, 3])
+    x = jnp.asarray(rng.normal(size=(2, 32)), jnp.float32)
+    y = np.asarray(quant.weight_only_linear(x, qw, weight_scale=scale),
+                   np.float32)
+    assert np.isfinite(y).all()
+    np.testing.assert_array_equal(y[:, 3], 0.0)
+
+
+def test_int8_matmul_pallas_interpret_parity():
+    """The in-kernel-dequant Pallas matmul (interpret mode on CPU) must
+    match the XLA composition ``x @ (w8.astype(bf16) * scale)`` — and
+    ``weight_only_linear`` must route through it when the Pallas backend
+    is on (FLAGS_pallas_interpret) for decode-shaped eligible operands."""
+    from paddle_tpu import flags
+    from paddle_tpu.ops.pallas.int8_matmul import int8_matmul_pallas
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(4, 256)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(256, 128)) * 0.1, jnp.float32)
+    qw, scale = quant.weight_quantize(w)
+    want = np.asarray(x @ (qw.astype(jnp.bfloat16)
+                           * scale.astype(jnp.bfloat16)), np.float32)
+    out = int8_matmul_pallas(x, qw, scale, interpret=True)
+    assert out.shape == (4, 128) and out.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                               rtol=2e-2, atol=2e-2)
+
+    # routing: weight_only_linear takes the kernel on the Pallas backend
+    flags.set_flags({"pallas_interpret": True})
+    try:
+        routed = np.asarray(
+            quant.weight_only_linear(x, qw, weight_scale=scale), np.float32)
+    finally:
+        flags.set_flags({"pallas_interpret": False})
+    np.testing.assert_allclose(routed, want, rtol=2e-2, atol=2e-2)
+
+    # ineligible shapes (K % 128 != 0) fall back to the XLA composition
+    w_odd = jnp.asarray(rng.normal(size=(60, 32)) * 0.1, jnp.float32)
+    qw_odd, sc_odd = quant.weight_quantize(w_odd)
+    x_odd = jnp.asarray(rng.normal(size=(2, 60)), jnp.bfloat16)
+    flags.set_flags({"pallas_interpret": True})
+    try:
+        y = np.asarray(quant.weight_only_linear(x_odd, qw_odd,
+                                                weight_scale=sc_odd),
+                       np.float32)
+    finally:
+        flags.set_flags({"pallas_interpret": False})
+    np.testing.assert_allclose(
+        y, np.asarray(x_odd @ (qw_odd.astype(jnp.bfloat16)
+                               * sc_odd.astype(jnp.bfloat16)), np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
 def test_int8_decode_parity_tiny_llama():
     """End-to-end: an int8-quantised tiny llama must greedy-decode the
     same tokens as bf16 for a non-degenerate prompt (serving parity)."""
