@@ -225,7 +225,7 @@ def spawn_point(layers, vocab, batch, seq, steps, warmup, peak_flops,
 # BENCH_OPS.json (one section per op, device-tagged).
 # ---------------------------------------------------------------------------
 
-def _time_compiled(fn, args, steps):
+def _time_compiled(fn, args, steps, extra=1000):
     """Mean per-application wall time of a shape-preserving op.
 
     Tunnel-chip measurement discipline (each rule bought by a failure
@@ -266,7 +266,6 @@ def _time_compiled(fn, args, steps):
         float(chained(*args))                       # scalar fetch = barrier
         return time.perf_counter() - t0
 
-    extra = 1000
     per = (wall(steps + extra) - wall(steps)) / extra
     return per, mem
 
@@ -363,12 +362,91 @@ def run_op_flash(steps, warmup):
             "rows": rows, "best": best}
 
 
+def run_op_decode_attention(steps):
+    """Flash-decode vs XLA-math sweep over (max_length x batch x depth) —
+    the measurement behind FLAGS_decode_attention_min_len and the b=8
+    long-context serving claim (BENCH_DECODE.json decode rows).  Each row
+    records the per-application time of both paths AND the dispatcher's
+    chosen path for that shape, so the threshold is re-derivable.  On CPU
+    the Pallas rows run in interpret mode: plumbing + artifact-shape
+    smoke only, no perf meaning."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu.ops.attention import (cached_decode_attention_reference,
+                                          decode_attention_path)
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention_pallas
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    interpret = not on_tpu
+    if on_tpu:
+        # the serving model's head geometry (llama3-arch GQA 32/8, d=128)
+        hq, hkv, d = 32, 8, 128
+        grid = [(1, 2048), (8, 2048), (1, 8192), (8, 8192)]
+        depth_pts = lambda L: sorted({128, L // 4, L - 1})
+        steps_eff, extra, dtype = steps, 1000, jnp.bfloat16
+    else:  # interpret-mode smoke: tiny shapes, tiny chains
+        hq, hkv, d = 4, 2, 64
+        grid = [(1, 256), (2, 512)]
+        depth_pts = lambda L: [17, L - 1]
+        steps_eff, extra, dtype = 2, 3, jnp.float32
+    rng = np.random.RandomState(0)
+    rows = []
+    for b, L in grid:
+        for depth in depth_pts(L):
+            q = jnp.asarray(rng.normal(size=(b, 1, hq, d)), dtype)
+            k = jnp.asarray(rng.normal(size=(b, L, hkv, d)), dtype)
+            v = jnp.asarray(rng.normal(size=(b, L, hkv, d)), dtype)
+            # per-row positions, serving-shaped: slots at heterogeneous
+            # depths; max(pos) = depth is what the live-prefix read bounds
+            pos = jnp.asarray([depth - (i * depth) // (2 * max(b - 1, 1))
+                               for i in range(b)], jnp.int32)
+            t_ref, _ = _time_compiled(
+                lambda q_, k_, v_: cached_decode_attention_reference(
+                    q_, k_, v_, pos), (q, k, v), steps_eff, extra=extra)
+            t_pal, _ = _time_compiled(
+                lambda q_, k_, v_: decode_attention_pallas(
+                    q_, k_, v_, pos, interpret=interpret),
+                (q, k, v), steps_eff, extra=extra)
+            path, why = decode_attention_path(b, 1, hq, hkv, d, L)
+            row = {"batch": b, "max_length": L, "depth": int(depth),
+                   "heads": [hq, hkv], "head_dim": d, "dtype": str(dtype.__name__),
+                   "xla_ms": round(t_ref * 1e3, 4),
+                   "pallas_ms": round(t_pal * 1e3, 4),
+                   "speedup": round(t_ref / t_pal, 3) if t_pal else None,
+                   "chosen_path": path}
+            if why:
+                row["fallback_reason"] = why
+            rows.append(row)
+            print(f"[decode-attn] b={b} L={L} depth={depth}: "
+                  f"xla {t_ref*1e3:.3f} ms, pallas {t_pal*1e3:.3f} ms "
+                  f"-> {path}", file=sys.stderr)
+    return {"steps": steps_eff, "rows": rows,
+            "dispatch_min_len": int(flags.flag("decode_attention_min_len")),
+            "block_kv_cap": int(flags.flag("decode_attention_block_kv")),
+            "read_model": "pallas rows stream only the live cache prefix "
+                          "(per-row positions ride in as scalar prefetch "
+                          "and clamp the KV-chunk index maps; dead-tail "
+                          "DMAs are elided) — per-step time tracks depth; "
+                          "xla rows stream the whole max_length every step",
+            "note": "cpu rows are interpret-mode plumbing smoke, no perf "
+                    "meaning" if interpret else
+                    "chosen_path records the cached_decode_attention "
+                    "dispatch for each shape at the committed flag default"}
+
+
+_OP_SECTIONS = {"rms_norm": lambda a: run_op_rms_norm(a.steps),
+                "flash": lambda a: run_op_flash(a.steps, a.warmup),
+                "decode_attention": lambda a: run_op_decode_attention(a.steps)}
+
+
 def run_op_bench(args):
     import jax
 
     dev = jax.devices()[0]
-    section = (run_op_rms_norm(args.steps) if args.op == "rms_norm"
-               else run_op_flash(args.steps, args.warmup))
+    section = _OP_SECTIONS[args.op](args)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_OPS.json")
     blob = {}
@@ -852,29 +930,62 @@ def run_decode_bench(args):
         _merge_decode_artifact(skey, {"decode": decode})
 
         short_len, long_len = decode_pts[0][1], decode_pts[-1][1]
-        d1 = next((d for d in decode if d["batch"] == 1
-                   and d["max_length"] == short_len), None)
-        d4 = next((d for d in decode if d["batch"] == 1
-                   and d["max_length"] == long_len), None)
-        if d1 and d4 and long_len > short_len:
-            growth = d4["per_step_ms"] / d1["per_step_ms"]
-            _merge_decode_artifact(skey, {"math_path_at_decode": {
-                "per_step_growth_short_to_long": round(growth, 3),
-                "max_lengths": [short_len, long_len],
-                "verdict": ("confirmed: the O(S*max_len) masked math "
-                            "path stays near the weight-stream bound at "
-                            f"{long_len} — no flash-decode kernel needed "
-                            "at these scales" if growth < 1.35 else
-                            "reversed: per-step time grows materially "
-                            "with max_length — a cached-decode kernel is "
-                            "warranted (round-4 verdict task 6)")}})
+
+        def _growth(batch):
+            lo = next((d for d in decode if d["batch"] == batch
+                       and d["max_length"] == short_len), None)
+            hi = next((d for d in decode if d["batch"] == batch
+                       and d["max_length"] == long_len), None)
+            if lo and hi and long_len > short_len:
+                return hi["per_step_ms"] / lo["per_step_ms"]
+            return None
+
+        g1, g8 = _growth(1), _growth(max(b for b, _ in decode_pts))
+        if g1 is not None:
+            mp = {"scope": "b=1",
+                  "per_step_growth_short_to_long": round(g1, 3),
+                  "max_lengths": [short_len, long_len],
+                  "verdict": ("confirmed AT b=1 ONLY: per-step time is "
+                              f"flat in max_length through {long_len} — "
+                              "the masked math path holds there" if
+                              g1 < 1.35 else
+                              "reversed even at b=1: per-step time grows "
+                              "with max_length — the flash-decode kernel "
+                              "regime")}
+            if g8 is not None:
+                nb = max(b for b, _ in decode_pts)
+                mp["growth_check_b" + str(nb)] = {
+                    "per_step_growth_short_to_long": round(g8, 3),
+                    "max_lengths": [short_len, long_len],
+                    "verdict": (f"flat at b={nb}: live-prefix reads "
+                                "holding the weight-stream bound" if
+                                g8 < 1.35 else
+                                f"regression at b={nb}: per-step time "
+                                f"grows {round(g8, 2)}x from {short_len} "
+                                f"to {long_len} — the dead cache tail is "
+                                "being streamed; shapes at kv_len >= "
+                                "FLAGS_decode_attention_min_len should "
+                                "be riding the flash-decode kernel "
+                                "(ops/pallas/decode_attention.py)")}
+            _merge_decode_artifact(skey, {"math_path_at_decode": mp})
 
     # -- weight-only int8 decode (round-4 verdict task 5) ----------------
     if "int8" in want and model is not None:
         from paddle_tpu.models.quantized import quantize_for_decode
+        from paddle_tpu.nn.quant import int8_matmul_path
 
         qmodel = quantize_for_decode(model)
         qbytes, fbytes = qmodel.hbm_bytes()
+        c = model.config
+        hd = c.head_dim
+        # every weight shape the decode step pushes through
+        # weight_only_linear — the path field says which matmul ran
+        gemms = [(c.hidden_size, c.num_attention_heads * hd),
+                 (c.hidden_size, c.num_key_value_heads * hd),
+                 (c.num_attention_heads * hd, c.hidden_size),
+                 (c.hidden_size, c.intermediate_size),
+                 (c.intermediate_size, c.hidden_size),
+                 (c.hidden_size, c.vocab_size)]
         rows = []
         for b, max_len in ([(1, 2048), (8, 2048)] if on_tpu
                            else [(1, 128)]):
@@ -885,11 +996,15 @@ def run_decode_bench(args):
                                    t1=16 if on_tpu else 4,
                                    t2=144 if on_tpu else 20)
             floor8 = qbytes / hbm_meas
+            paths = {int8_matmul_path(b, k, n) for k, n in gemms}
             rows.append({"batch": b, "max_length": max_len,
                          "per_step_ms": round(sec * 1e3, 4),
                          "tokens_per_sec_per_chip": round(b / sec, 1),
                          "int8_weight_stream_floor_ms":
-                             round(floor8 * 1e3, 4)})
+                             round(floor8 * 1e3, 4),
+                         "matmul_path": (paths.pop() if len(paths) == 1
+                                         else "mixed:" + ",".join(
+                                             sorted(paths)))})
             print(f"int8 decode b={b} L={max_len}: {sec*1e3:.3f} ms/step "
                   f"({b/sec:.0f} tok/s)", file=sys.stderr)
         bf16 = {(d["batch"], d["max_length"]): d["per_step_ms"]
@@ -1052,7 +1167,8 @@ def main():
     ap.add_argument("--selftest", action="store_true",
                     help="run the real-TPU test lane (pytest -m tpu on this "
                          "chip) instead of the benchmark")
-    ap.add_argument("--op", choices=["rms_norm", "flash"],
+    ap.add_argument("--op", choices=["rms_norm", "flash",
+                                     "decode_attention"],
                     help="op-level perf harness: reproduce the kernel "
                          "measurement tables into BENCH_OPS.json")
     ap.add_argument("--decode", action="store_true",

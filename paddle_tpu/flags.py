@@ -131,3 +131,18 @@ DEFINE("rms_norm_pallas_min_dim", 1 << 31,
        "and Mosaic testbed.")
 DEFINE("flash_attention_block_kv", 1024,
        "Pallas flash-attention kv block size")
+# flash-decode dispatch threshold from BENCH_DECODE.json decode rows (940M
+# llama3-arch, v5e): the XLA math path sits AT the bf16 weight-stream bound
+# through max_length 2048 (0.97-1.07x of bound, b=1 and b=8) — a kernel buys
+# nothing there — but drops to 0.652x at b=8 max_length 8192 because it
+# streams the dead cache tail; those shapes route to the split-KV Pallas
+# flash-decode kernel (ops/pallas/decode_attention.py), whose live-prefix
+# reads restore O(depth) per-step cost.
+# reproducible: `python bench.py --op decode_attention` -> BENCH_OPS.json
+DEFINE("decode_attention_min_len", 4096,
+       "route cached_decode_attention to the Pallas flash-decode kernel "
+       "when the cache length is at least this (Pallas backends only); "
+       "below it the XLA math path already runs at the weight-stream bound")
+DEFINE("decode_attention_block_kv", 512,
+       "flash-decode KV chunk size (cap; the kernel picks the largest "
+       "128-aligned divisor of max_length at or below it)")

@@ -199,13 +199,24 @@ class LlamaAttention(Layer):
           * **incremental** (traced ``pos``, q_len 1): HBM-bound; runs
             :func:`~paddle_tpu.ops.attention.cached_decode_attention` —
             grouped GQA, bf16 operands, fp32 accumulation, no K/V
-            expansion.
+            expansion.  That dispatcher in turn routes long caches
+            (max_len >= FLAGS_decode_attention_min_len) on Pallas
+            backends to the split-KV flash-decode kernel
+            (ops/pallas/decode_attention.py): the position vector rides
+            into the kernel as a scalar-prefetch operand and clamps the
+            KV-chunk index maps, so each step streams only each row's
+            LIVE cache prefix — per-step cost follows actual context
+            depth, not max_len (the b=8 max_len-8192 regression in
+            BENCH_DECODE.json).  Short caches keep the XLA math path,
+            which already runs at the weight-stream bound.
 
         ``pos`` may also be an int (B,) vector of PER-ROW positions — the
         serving engine's slot batch, every row a different request at a
         different depth.  The write becomes a batched scatter (row i at
         column pos[i]) and the cache mask compares against the row's own
-        position vector; the scalar paths are untouched.
+        position vector; the scalar paths are untouched.  The per-row
+        vector is exactly the live-prefix hint the flash-decode kernel
+        consumes — no extra plumbing between the engine and the kernel.
 
         x: (B, s, H*D).  Returns (out, cache).
         """

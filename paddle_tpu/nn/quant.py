@@ -25,7 +25,22 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear"]
+           "llm_int8_linear", "int8_matmul_path"]
+
+
+def int8_matmul_path(rows: int, k: int, n: int) -> str:
+    """Which path :func:`weight_only_linear` takes for an int8 (K, N)
+    weight at this activation row count: ``"pallas_int8"`` (in-kernel
+    dequant, HBM streams int8 bytes) or ``"xla_dequant"`` (XLA
+    composition — the dequantised bf16 copy gets hoisted out of decode
+    scans).  Mirrors the dispatch below + the kernel's shape eligibility;
+    bench.py records it per int8_decode row so the artifact says which
+    matmul actually ran (the pre-wiring rows could not)."""
+    from ..ops import _dispatch
+    if (_dispatch.use_pallas() and k % 128 == 0 and n % 128 == 0
+            and 0 < rows <= 256):
+        return "pallas_int8"
+    return "xla_dequant"
 
 
 def weight_quantize(x, algo: str = "weight_only_int8"):

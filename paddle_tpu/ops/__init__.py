@@ -9,7 +9,9 @@ TPU backends (or when FLAGS_pallas_interpret forces interpreter mode for
 testing).
 """
 
-from .attention import flash_attention, flash_attention_reference
+from .attention import (cached_decode_attention,
+                        cached_decode_attention_reference, flash_attention,
+                        flash_attention_reference)
 from .norms import rms_norm, rms_norm_reference
 from .rope import apply_rope, build_rope_cache, fused_rope
 from .fused import (fused_attention, fused_bias_dropout_residual_layer_norm,
@@ -20,6 +22,7 @@ from .fused import (fused_attention, fused_bias_dropout_residual_layer_norm,
 
 __all__ = [
     "flash_attention", "flash_attention_reference",
+    "cached_decode_attention", "cached_decode_attention_reference",
     "rms_norm", "rms_norm_reference",
     "apply_rope", "build_rope_cache", "fused_rope",
     "fused_bias_dropout_residual_layer_norm",

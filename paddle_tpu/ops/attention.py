@@ -113,9 +113,43 @@ def flash_attention_reference(q, k, v, attn_mask=None, dropout_p: float = 0.0,
     return out
 
 
+def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
+                          kv_len: int, has_extra_mask: bool = False):
+    """The flash-decode dispatch decision for one shape, exposed so
+    bench.py can record the chosen path per row: returns
+    ``("pallas_decode", None)`` or ``("xla_math", reason)``.
+
+    Threshold provenance (BENCH_DECODE.json, 940M llama3-arch, v5e): the
+    XLA math path sits AT the bf16 weight-stream bound through
+    max_length 2048 (0.97–1.07x) — routing those shapes to a kernel buys
+    nothing — but falls to 0.652x at b=8, max_length 8192 because it
+    streams the dead cache tail; that regime goes to the Pallas
+    flash-decode kernel (FLAGS_decode_attention_min_len, default 4096).
+    """
+    from .. import flags as _flags
+    if not _dispatch.use_pallas():
+        return "xla_math", (f"no Pallas-capable backend "
+                            f"({_dispatch.default_backend()})")
+    if has_extra_mask:
+        return "xla_math", "extra_mask"
+    if kv_len < int(_flags.flag("decode_attention_min_len")):
+        return "xla_math", (f"kv_len {kv_len} < "
+                            f"FLAGS_decode_attention_min_len (XLA at the "
+                            f"weight-stream bound there)")
+    if hkv == 0 or hq % hkv:
+        return "xla_math", f"q heads {hq} not a multiple of kv heads {hkv}"
+    if s * (hq // hkv) > 64:
+        return "xla_math", f"s*G = {s * (hq // hkv)} > 64 (prefill-shaped)"
+    if d > 256:
+        return "xla_math", f"head_dim {d} > 256"
+    if kv_len % 128:
+        return "xla_math", f"max_length {kv_len} not 128-aligned"
+    return "pallas_decode", None
+
+
 def cached_decode_attention(q, k_cache, v_cache, pos,
                             scale: Optional[float] = None,
-                            extra_mask=None):
+                            extra_mask=None, live_len: Optional[int] = None):
     """Incremental decode attention over a pre-allocated cache — the
     serving hot path (parity: the reference's masked_multihead_attention /
     fused decode-attention core, upstream
@@ -126,7 +160,50 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
     ``pos..pos+s``; slots ``> pos+i`` are masked.  ``pos`` is a scalar
     (whole-batch decode, the ``generate()`` path) or an int (B,) vector of
     per-row positions (the serving engine's slot batch, where every row
-    is a different request at a different depth).
+    is a different request at a different depth).  ``live_len``: optional
+    STATIC upper bound on max(pos)+s — both paths then read only the
+    first ``live_len`` cache slots.
+
+    Dispatch: long-cache shapes (kv_len >= FLAGS_decode_attention_min_len)
+    on Pallas backends route to the split-KV flash-decode kernel
+    (ops/pallas/decode_attention.py), whose scalar-prefetch-clamped index
+    maps stream only each row's LIVE cache prefix — per-step cost scales
+    with actual context depth, not max_length.  Everything else (and any
+    ``extra_mask``) runs :func:`cached_decode_attention_reference`, the
+    XLA math path, which the decode bench measured at the weight-stream
+    bound for short caches.  Returns (B, s, Hq, D) in q.dtype.
+    """
+    b, s, hq, d = q.shape
+    _, kv_len, hkv, _ = k_cache.shape
+    path, reason = decode_attention_path(b, s, hq, hkv, d, kv_len,
+                                         extra_mask is not None)
+    if path == "pallas_decode":
+        try:
+            from .pallas.decode_attention import decode_attention_pallas
+            return decode_attention_pallas(
+                q, k_cache, v_cache, pos, scale=scale, live_len=live_len,
+                interpret=_dispatch.pallas_interpret())
+        except NotImplementedError as e:
+            reason = str(e)
+    if _dispatch.use_pallas() and not reason.startswith(
+            ("no Pallas", "kv_len", "extra_mask")):
+        # an above-threshold shape falling back IS a perf surprise worth
+        # one log line; below-threshold / masked shapes are the design
+        vlog_once(1, f"decode_attention:{reason}",
+                  f"cached_decode_attention: falling back to the XLA math "
+                  f"path ({reason})")
+    return cached_decode_attention_reference(q, k_cache, v_cache, pos,
+                                             scale=scale,
+                                             extra_mask=extra_mask,
+                                             live_len=live_len)
+
+
+def cached_decode_attention_reference(q, k_cache, v_cache, pos,
+                                      scale: Optional[float] = None,
+                                      extra_mask=None,
+                                      live_len: Optional[int] = None):
+    """The XLA math path of :func:`cached_decode_attention` (and its
+    numerical oracle): masked softmax over the whole cache read.
 
     Decode is HBM-bound, so this path is shaped around traffic, where the
     generic ``flash_attention_reference`` (a training oracle) is not:
@@ -141,10 +218,16 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
 
     Measured (BENCH_DECODE.json, 940M llama, b=8, L=8192): this path +
     in-place cache writes took the step from 42.7 ms to the weight-stream
-    regime — the round-4 "math path at decode" stance survives only with
-    this dataflow.  Returns (B, s, Hq, D) in q.dtype.
+    regime at short max_length; its per-step cost is O(S·max_len) —
+    streaming the dead cache tail — which is what the flash-decode
+    kernel's live-prefix reads fix at long max_length.
     """
     b, s, hq, d = q.shape
+    if live_len is not None and live_len < k_cache.shape[1]:
+        k_cache = k_cache[:, :live_len]
+        v_cache = v_cache[:, :live_len]
+        if extra_mask is not None and extra_mask.shape[-1] != live_len:
+            extra_mask = extra_mask[..., :live_len]
     _, L, hkv, _ = k_cache.shape
     g = hq // hkv
     if scale is None:

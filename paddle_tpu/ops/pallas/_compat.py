@@ -1,0 +1,12 @@
+"""Small jax-version compat shims for the Pallas TPU kernels.
+
+``pltpu.TPUCompilerParams`` was renamed ``pltpu.CompilerParams`` across
+jax releases; the kernels in this package run on both spellings.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")

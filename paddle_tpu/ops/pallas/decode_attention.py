@@ -1,0 +1,217 @@
+"""Split-KV flash-decode Pallas TPU kernel — the batched/long-context
+serving hot path.
+
+TPU-native equivalent of the FlashDecoding scheme (Dao et al.; the
+PagedAttention-class engines' decode kernel on GPU): at q_len 1 the
+(1, L) score row gives the MXU nothing to tile, so the win is pure
+dataflow — split the KV cache into chunks, keep online-softmax partials
+(m, l, acc) in VMEM across the chunk walk, and never materialise the
+(B, Hq, s, L) score tensor the XLA math path
+(:func:`~paddle_tpu.ops.attention.cached_decode_attention_reference`)
+builds in HBM.
+
+What makes this kernel O(actual context depth) instead of O(max_length)
+— the regime BENCH_DECODE.json flagged (b=8, max_length 8192: 4.27 ms vs
+the 2.78 ms bf16 weight-stream floor, 0.652x of the bound, because the
+math path streams and mask-softmaxes the dead tail of the pre-allocated
+cache every step):
+
+  * per-row positions arrive as a **scalar-prefetch** operand, so the
+    KV-chunk BlockSpec index maps can read them *before* the grid step
+    runs and **clamp dead-tail chunks to the last live block** — Pallas
+    elides the DMA when consecutive grid steps map to the same block, so
+    the dead tail of the cache is never streamed from HBM.  This is the
+    dynamic-shape-safe form of "the host passes ceil((max(pos)+s)/BLOCK)
+    as the KV-chunk grid bound": the bound is derived in-kernel from the
+    position vector itself, the grid stays static, and the serving
+    engine's once-jitted step function never retraces as slots deepen;
+  * a caller who *does* know a static bound (the bench depth sweep)
+    passes ``live_len`` and the grid is trimmed outright;
+  * dead chunks also skip their matmuls via ``pl.when`` — a skipped
+    chunk costs one predicated-off grid step, not bandwidth.
+
+GQA stays grouped: Q is reshaped to (B, Hkv, G·s, D) and each kv head's
+(G·s, D) query tile contracts the cache directly — bf16 operands on the
+MXU with an fp32 accumulator, no Hq/Hkv KV broadcast.  The cache is read
+in its **native** (B, L, Hkv, D) layout, viewed as (B, L, Hkv·D) so each
+KV chunk is one contiguous DMA; the per-head (bk, D) slice is a static
+lane slice in VMEM.  Per-row ``pos`` masking happens inside the kernel
+(key j visible to query row (si, g) iff j <= pos_b + si) with the same
+fully-masked-row convention as the flash kernel (out = 0).
+
+The cross-chunk merge is the same LSE algebra the ring-attention path
+uses (ops/ring_attention.py ``merge_attention``), specialised to the
+running (m, l, acc) form since chunks arrive sequentially.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._compat import CompilerParams
+
+NEG_INF = -1e30
+_LANES = 128  # VPU lane width: m/l scratch rows are padded to this
+_MAX_Q_ROWS = 64  # s·G rows cap — beyond this the tile is prefill-shaped
+
+
+def _pick_block_kv(kv_len: int, cap: int) -> int:
+    """Largest KV chunk <= cap that divides kv_len on the 128-lane
+    tiling; 0 when none exists (caller falls back to XLA)."""
+    for d in range(min(cap, kv_len), 0, -1):
+        if kv_len % d == 0 and d % 128 == 0:
+            return d
+    return 0
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc, *,
+            scale, s, g, hkv, d, rows, rows_p, bk, chunks):
+    bi = pl.program_id(0)
+    ki = pl.program_id(1)
+    pos_b = pos_ref[bi]
+    last_live = (pos_b + s - 1) // bk  # last chunk holding a visible key
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    @pl.when(ki <= last_live)
+    def _compute():
+        # key j visible to row r = si·g + gi iff j <= pos_b + si; rows
+        # beyond s·g are sublane padding (fully masked, out = 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (rows_p, bk), 1) + ki * bk
+        rr = jax.lax.broadcasted_iota(jnp.int32, (rows_p, bk), 0)
+        keep = (cols <= pos_b + rr // g) & (rr < rows)
+        kv = k_ref[0]  # (bk, hkv·d) — one contiguous chunk, all kv heads
+        vv = v_ref[0]
+        for h in range(hkv):
+            qh = q_ref[0, h]                   # (rows_p, d)
+            kh = kv[:, h * d:(h + 1) * d]      # static lane slice
+            vh = vv[:, h * d:(h + 1) * d]
+            sc = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (rows_p, bk)
+            sc = jnp.where(keep, sc, NEG_INF)
+            m_prev = m_sc[h][:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)    # rescale earlier chunks
+            p = jnp.exp(sc - m_new)
+            p = jnp.where(keep, p, 0.0)  # kill exp(NEG_INF - NEG_INF) = 1
+            l_new = alpha * l_sc[h][:, :1] + jnp.sum(p, axis=1,
+                                                     keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_sc[h] = acc_sc[h] * alpha + pv
+            m_sc[h] = jnp.broadcast_to(m_new, m_sc[h].shape)
+            l_sc[h] = jnp.broadcast_to(l_new, l_sc[h].shape)
+
+    @pl.when(ki == chunks - 1)
+    def _finish():
+        for h in range(hkv):
+            l = l_sc[h][:, :1]
+            o_ref[0, h] = (acc_sc[h]
+                           / jnp.maximum(l, 1e-37)).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k_cache, v_cache, pos,
+                            scale: Optional[float] = None,
+                            block_kv: int = 0,
+                            live_len: Optional[int] = None,
+                            interpret: bool = False):
+    """Flash-decode over a pre-allocated cache → (B, s, Hq, D) in q.dtype.
+
+    q: (B, s, Hq, D) new-token queries (s = 1 in steady-state decode,
+    small for prefill-into-occupied-slot); k_cache/v_cache:
+    (B, L, Hkv, D) with the new K/V already written; ``pos``: scalar or
+    int (B,) per-row positions — cache slots > pos+i are masked.
+    ``live_len``: optional static bound on max(pos)+s (trims the chunk
+    grid outright; without it the scalar-prefetch clamp stops the HBM
+    streaming at each row's live prefix dynamically).  Raises
+    NotImplementedError for shapes the kernel does not cover (callers
+    fall back to the XLA math path).
+    """
+    b, s, hq, d = q.shape
+    _, kv_len, hkv, _ = k_cache.shape
+    if hq % hkv or hkv == 0:
+        raise NotImplementedError(
+            f"q heads ({hq}) must be a multiple of kv heads ({hkv})")
+    g = hq // hkv
+    rows = s * g
+    if rows > _MAX_Q_ROWS:
+        raise NotImplementedError(
+            f"s*G = {rows} > {_MAX_Q_ROWS}: prefill-shaped q tile belongs "
+            f"to the flash kernel")
+    if d > 256:
+        raise NotImplementedError(f"head_dim {d} > 256")
+    if scale is None:
+        scale = d ** -0.5
+    if not block_kv:
+        from ...flags import flag
+        block_kv = int(flag("decode_attention_block_kv"))
+    bk = _pick_block_kv(kv_len, block_kv)
+    if not bk:
+        raise NotImplementedError(
+            f"max_length {kv_len} has no 128-aligned chunk divisor "
+            f"<= {block_kv}")
+    chunks = kv_len // bk
+    if live_len is not None:
+        chunks = max(1, min(chunks, -(-int(live_len) // bk)))
+    rows_p = max(8, -(-rows // 8) * 8)  # sublane-pad the q tile
+    if getattr(pos, "ndim", 0) == 1:
+        pos_arr = jnp.asarray(pos, jnp.int32)
+    else:
+        pos_arr = jnp.full((b,), pos, jnp.int32)
+    # grouped-GQA q tile: (B, Hkv, s·G, D), row r = si·g + gi
+    qg = q.reshape(b, s, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, rows, d)
+    if rows_p != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_p - rows), (0, 0)))
+    # native cache layout, viewed flat so a KV chunk is one contiguous DMA
+    k2 = k_cache.reshape(b, kv_len, hkv * d)
+    v2 = v_cache.reshape(b, kv_len, hkv * d)
+
+    kernel = functools.partial(
+        _kernel, scale=float(scale), s=s, g=g, hkv=hkv, d=d, rows=rows,
+        rows_p=rows_p, bk=bk, chunks=chunks)
+
+    def kv_idx(bi, ki, pos_ref):
+        # dead-tail chunks re-map to the last live block: same index as
+        # the previous grid step → Pallas elides the DMA, so HBM traffic
+        # stops at this row's live prefix
+        return (bi, jnp.minimum(ki, (pos_ref[bi] + s - 1) // bk), 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, chunks),
+            in_specs=[
+                pl.BlockSpec((1, hkv, rows_p, d),
+                             lambda bi, ki, pos_ref: (bi, 0, 0, 0)),
+                pl.BlockSpec((1, bk, hkv * d), kv_idx),
+                pl.BlockSpec((1, bk, hkv * d), kv_idx),
+            ],
+            out_specs=pl.BlockSpec((1, hkv, rows_p, d),
+                                   lambda bi, ki, pos_ref: (bi, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((hkv, rows_p, d), jnp.float32),
+                pltpu.VMEM((hkv, rows_p, _LANES), jnp.float32),
+                pltpu.VMEM((hkv, rows_p, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows_p, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(pos_arr, qg, k2, v2)
+    out = out[:, :, :rows].reshape(b, hkv, s, g, d).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, s, hq, d).astype(q.dtype)

@@ -12,7 +12,14 @@ Design (Orca-style iteration-level scheduling, expressed TPU-first):
     position vectors (ops/attention.py cache masking, llama.py scatter
     writes) are what let one program serve rows at different depths, and
     per-slot sampling vectors (generation.py ``sample_tokens``, traced
-    form) let greedy and sampled requests share a batch;
+    form) let greedy and sampled requests share a batch.  The same
+    position vector doubles as the flash-decode kernel's live-prefix
+    hint: at max_length >= FLAGS_decode_attention_min_len the attention
+    dispatcher hands it to ops/pallas/decode_attention.py as a
+    scalar-prefetch operand that clamps the KV-chunk reads, so each step
+    streams only each slot's live cache prefix — slots at shallow,
+    heterogeneous depths under a worst-case-sized max_length stop paying
+    for the dead tail, with no retrace;
   * **prefill** reuses the existing static-``pos=0`` path — the one that
     routes through the Pallas flash kernel on TPU: admitted prompts are
     right-padded to a power-of-two bucket, run through ``decode_step`` on
@@ -205,7 +212,14 @@ class ServingEngine:
     def step(self) -> List[int]:
         """One scheduler tick: admit queued requests into free slots
         (batched prefill waves), then run ONE jitted decode step over the
-        slot batch.  Returns the request ids finished this tick."""
+        slot batch.  Returns the request ids finished this tick.
+
+        Idle ticks (no queued work, no active slots — the poll loop of a
+        server waiting for traffic) return immediately: no admission
+        scan, no device dispatch of a fully-masked decode step."""
+        if not self._queue and not self._active.any():
+            self.last_occupancy = 0
+            return []
         finished = self._admit()
         self.last_occupancy = int(self._active.sum())
         if not self._active.any():

@@ -38,6 +38,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "tpu: runs on the real TPU chip (PT_TPU_LANE=1 pytest -m tpu)")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight mesh/integration tests excluded from the "
+        "tier-1 time budget (-m 'not slow'); run them with -m slow")
 
 
 def pytest_collection_modifyitems(config, items):

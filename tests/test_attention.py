@@ -263,6 +263,54 @@ class TestCachedDecodeAttention:
                                    np.asarray(want, np.float32),
                                    rtol=3e-2, atol=3e-2)
 
+    @pytest.mark.parametrize("s", [2, 3])
+    def test_per_row_pos_s_gt1_matches_oracle(self, s):
+        """The prefill-into-occupied-slot shape: a (B,) position vector
+        with s > 1 new tokens per row must equal the training oracle
+        under the equivalent (B, 1, s, L) cache mask — GQA included."""
+        from paddle_tpu.ops.attention import (cache_mask,
+                                              cached_decode_attention,
+                                              flash_attention_reference)
+
+        q, k, v, _ = self._setup(b=2, L=16, hq=8, hkv=2, s=s, seed=3)
+        pos = jnp.asarray([5, 11], jnp.int32)
+        got = cached_decode_attention(q, k, v, pos)
+        want = flash_attention_reference(
+            q, k, v, attn_mask=cache_mask(pos, s, k.shape[1]),
+            return_lse=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # and row-by-row: each row must equal its own scalar-pos call
+        for r, p in enumerate((5, 11)):
+            solo = cached_decode_attention(q[r:r + 1], k[r:r + 1],
+                                           v[r:r + 1], p)
+            np.testing.assert_allclose(np.asarray(got[r:r + 1]),
+                                       np.asarray(solo),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_per_row_pos_s_gt1_with_extra_mask(self):
+        """Per-row pos, s > 1, GQA AND an extra key-padding mask all
+        composed — the full serving shape — vs the oracle with the same
+        mask assembled by hand."""
+        from paddle_tpu.ops.attention import (cache_mask,
+                                              cached_decode_attention,
+                                              flash_attention_reference)
+
+        s, L = 3, 16
+        q, k, v, _ = self._setup(b=2, L=L, hq=8, hkv=2, s=s, seed=4)
+        pos = jnp.asarray([6, 9], jnp.int32)
+        em = jnp.ones((2, L), bool).at[:, :2].set(False)   # (B, L) padding
+        got = cached_decode_attention(q, k, v, pos, extra_mask=em)
+        mask = cache_mask(pos, s, L) & em[:, None, None, :]
+        want = flash_attention_reference(q, k, v, attn_mask=mask,
+                                         return_lse=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # rank-3 (B, s, L) extra_mask form agrees with the (B, L) form
+        em3 = jnp.broadcast_to(em[:, None, :], (2, s, L))
+        got3 = cached_decode_attention(q, k, v, pos, extra_mask=em3)
+        np.testing.assert_allclose(np.asarray(got3), np.asarray(got))
+
     def test_extra_mask_composes(self):
         from paddle_tpu.ops.attention import cached_decode_attention
 

@@ -58,6 +58,7 @@ def test_wkv_extreme_keys_stay_finite():
     assert np.isfinite(out).all()
 
 
+@pytest.mark.slow
 def test_wkv_grad_finite_difference():
     rng = np.random.RandomState(2)
     B, L, C = 1, 4, 2
@@ -165,18 +166,21 @@ def _assert_overfits(losses):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_ernie_moe_train_step_on_mesh(mesh_2x2x2):
     pt.seed(0)
     model = ErnieMoEForCausalLM(tiny_ernie_moe_config())
     _assert_overfits(_train(model, _lm_batch(256), mesh_2x2x2))
 
 
+@pytest.mark.slow
 def test_mamba_train_step_on_mesh(mesh_2x2x2):
     pt.seed(0)
     model = Mamba2ForCausalLM(tiny_mamba2_config())
     _assert_overfits(_train(model, _lm_batch(256), mesh_2x2x2))
 
 
+@pytest.mark.slow
 def test_rwkv_train_step_on_mesh(mesh_2x2x2):
     pt.seed(0)
     model = RwkvForCausalLM(tiny_rwkv_config())
@@ -201,6 +205,7 @@ def test_dit_train_step_on_mesh(mesh_2x2x2):
     _assert_overfits(_train(model, batch, mesh_2x2x2))
 
 
+@pytest.mark.slow
 def test_qwen2_vl_train_step_on_mesh(mesh_2x2x2):
     pt.seed(0)
     cfg = tiny_qwen2_vl_config()
@@ -238,6 +243,7 @@ def _ernie_curve(hcg, zero_stage):
     return losses
 
 
+@pytest.mark.slow
 def test_ernie_moe_sharded_matches_serial():
     """MoE + TP + FSDP composition: same seeds, same data → same loss
     curve as the single-device run (the hybrid_parallel_* pattern)."""

@@ -1,0 +1,203 @@
+"""Flash-decode Pallas kernel (ops/pallas/decode_attention.py), interpret
+mode on CPU: parity vs cached_decode_attention's XLA math path across the
+shapes the serving engine produces — scalar and per-row ``pos``, GQA group
+sizes {1, 4}, s > 1 (prefill-into-occupied-slot), depths ending mid-KV-
+chunk, bf16 — plus the cached_decode_attention dispatch contract (routing,
+threshold, extra_mask fallback).  The real-TPU lane (tests/test_tpu_lane.py)
+compiles the same kernel via Mosaic."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.ops.attention import (cached_decode_attention,
+                                      cached_decode_attention_reference,
+                                      decode_attention_path)
+from paddle_tpu.ops.pallas.decode_attention import decode_attention_pallas
+
+
+def _qkv(b, s, hq, hkv, d, L, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, s, hq, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(b, L, hkv, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(b, L, hkv, d)), dtype)
+    return q, k, v
+
+
+CASES = [
+    # (b, s, hq, hkv, d, L, pos) — pos None means a per-row vector
+    (2, 1, 8, 2, 64, 256, 77),        # GQA g=4, depth ends mid-chunk
+    (2, 1, 4, 4, 32, 256, 100),       # g=1 (MHA)
+    (1, 1, 8, 2, 64, 256, 0),         # first token
+    (2, 1, 8, 2, 64, 256, 255),       # last slot live
+    (1, 1, 8, 2, 64, 384, 127),       # depth ends exactly at a chunk edge
+    (2, 1, 8, 2, 64, 256, None),      # per-row positions
+    (2, 3, 8, 2, 64, 256, None),      # per-row, s>1 (prefill-into-slot)
+    (3, 2, 4, 4, 16, 256, None),      # per-row, s>1, g=1
+]
+
+
+@pytest.mark.parametrize("b,s,hq,hkv,d,L,pos", CASES)
+def test_kernel_matches_xla_math_path(b, s, hq, hkv, d, L, pos):
+    q, k, v = _qkv(b, s, hq, hkv, d, L, seed=b * 100 + s)
+    if pos is None:
+        pos = jnp.asarray([5, 130, 200][:b], jnp.int32)
+    got = decode_attention_pallas(q, k, v, pos, block_kv=128,
+                                  interpret=True)
+    want = cached_decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_bf16_fp32_accum():
+    q, k, v = _qkv(2, 1, 8, 2, 64, 256, seed=7, dtype=jnp.bfloat16)
+    pos = jnp.asarray([33, 199], jnp.int32)
+    got = decode_attention_pallas(q, k, v, pos, block_kv=128,
+                                  interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = cached_decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_live_len_hint_trims_but_matches():
+    q, k, v = _qkv(2, 1, 8, 2, 64, 512, seed=9)
+    pos = jnp.asarray([10, 140], jnp.int32)
+    full = decode_attention_pallas(q, k, v, pos, block_kv=128,
+                                   interpret=True)
+    trimmed = decode_attention_pallas(q, k, v, pos, block_kv=128,
+                                      live_len=160, interpret=True)
+    np.testing.assert_allclose(np.asarray(trimmed), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
+    ref = cached_decode_attention_reference(q, k, v, pos, live_len=160)
+    np.testing.assert_allclose(np.asarray(trimmed), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_scalar_pos_matches_vector_pos():
+    q, k, v = _qkv(2, 1, 8, 2, 64, 256, seed=11)
+    a = decode_attention_pallas(q, k, v, 77, block_kv=128, interpret=True)
+    bvec = decode_attention_pallas(q, k, v, jnp.asarray([77, 77], jnp.int32),
+                                   block_kv=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(bvec))
+
+
+def test_shape_ineligibility_raises():
+    q, k, v = _qkv(1, 1, 8, 2, 64, 200, seed=13)   # 200 has no 128-divisor
+    with pytest.raises(NotImplementedError, match="128-aligned"):
+        decode_attention_pallas(q, k, v, 5, interpret=True)
+    q, k, v = _qkv(1, 17, 8, 2, 64, 256, seed=13)  # s*G = 68 > 64 rows
+    with pytest.raises(NotImplementedError, match="prefill-shaped"):
+        decode_attention_pallas(q, k, v, 5, interpret=True)
+
+
+# -- cached_decode_attention dispatch contract -------------------------------
+
+class TestDispatch:
+    def setup_method(self, _):
+        flags.set_flags({"pallas_interpret": True,
+                         "decode_attention_min_len": 256})
+
+    def teardown_method(self, _):
+        flags.set_flags({"pallas_interpret": False,
+                         "decode_attention_min_len": 4096})
+
+    def test_routes_long_cache_to_kernel(self, monkeypatch):
+        from paddle_tpu.ops.pallas import decode_attention as mod
+
+        calls = []
+        real = mod.decode_attention_pallas
+        monkeypatch.setattr(
+            mod, "decode_attention_pallas",
+            lambda *a, **kw: calls.append(1) or real(*a, **kw))
+        q, k, v = _qkv(2, 1, 8, 2, 64, 256, seed=17)
+        pos = jnp.asarray([5, 130], jnp.int32)
+        got = cached_decode_attention(q, k, v, pos)
+        assert calls, "eligible shape did not route to the Pallas kernel"
+        want = cached_decode_attention_reference(q, k, v, pos)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_short_cache_stays_on_xla(self, monkeypatch):
+        from paddle_tpu.ops.pallas import decode_attention as mod
+
+        calls = []
+        monkeypatch.setattr(mod, "decode_attention_pallas",
+                            lambda *a, **kw: calls.append(1))
+        q, k, v = _qkv(1, 1, 8, 2, 64, 128, seed=19)  # below min_len 256
+        cached_decode_attention(q, k, v, 5)
+        assert not calls
+        assert decode_attention_path(1, 1, 8, 2, 64, 128)[0] == "xla_math"
+
+    def test_extra_mask_falls_back(self, monkeypatch):
+        from paddle_tpu.ops.pallas import decode_attention as mod
+
+        calls = []
+        monkeypatch.setattr(mod, "decode_attention_pallas",
+                            lambda *a, **kw: calls.append(1))
+        q, k, v = _qkv(1, 1, 8, 2, 64, 256, seed=23)
+        em = (jnp.arange(256) >= 4)[None]
+        out = cached_decode_attention(q, k, v, 9, extra_mask=em)
+        assert not calls
+        want = cached_decode_attention_reference(q, k, v, 9, extra_mask=em)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want))
+
+    def test_unaligned_length_falls_back_cleanly(self):
+        # eligible by the cheap checks is impossible here (kv_len % 128
+        # rejects first), so hit the in-kernel NotImplementedError via a
+        # tight block cap: dispatcher must return the XLA answer
+        flags.set_flags({"decode_attention_block_kv": 64})
+        try:
+            q, k, v = _qkv(1, 1, 8, 2, 64, 256, seed=29)
+            out = cached_decode_attention(q, k, v, 40)
+            want = cached_decode_attention_reference(q, k, v, 40)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5)
+        finally:
+            flags.set_flags({"decode_attention_block_kv": 512})
+
+    def test_jit_traced_positions(self):
+        q, k, v = _qkv(2, 1, 8, 2, 64, 256, seed=31)
+        pos = jnp.asarray([5, 130], jnp.int32)
+        got = jax.jit(cached_decode_attention)(q, k, v, pos)
+        want = cached_decode_attention_reference(q, k, v, pos)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_llama_decode_step_through_kernel(self):
+        """The serving shape end to end: a llama decode_step with a
+        per-row position vector must produce the same logits whether the
+        incremental attention runs the flash-decode kernel or the XLA
+        math path (min_len flag is the only switch)."""
+        import paddle_tpu as pt
+        from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+        from paddle_tpu.models.generation import init_kv_cache
+
+        pt.seed(5)
+        lm = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+        lm.eval()
+        ids = jnp.asarray(np.random.default_rng(6).integers(
+            0, 256, (2, 7)), jnp.int32)
+        cache = init_kv_cache(lm.config, 2, 128)   # 128-aligned cache
+        _, cache = lm.decode_step(ids, cache, 0)
+        positions = jnp.asarray([7, 5], jnp.int32)
+        tok = jnp.asarray([[3], [9]], jnp.int32)
+        try:
+            flags.set_flags({"decode_attention_min_len": 128})
+            assert decode_attention_path(
+                2, 1, lm.config.num_attention_heads,
+                lm.config.num_key_value_heads, lm.config.head_dim,
+                128)[0] == "pallas_decode"
+            logits_k, cache_k = lm.decode_step(tok, cache, positions)
+            flags.set_flags({"decode_attention_min_len": 1 << 31})
+            logits_x, cache_x = lm.decode_step(tok, cache, positions)
+        finally:
+            flags.set_flags({"decode_attention_min_len": 256})
+        np.testing.assert_allclose(np.asarray(logits_k),
+                                   np.asarray(logits_x),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(cache_k), np.asarray(cache_x),
+                                   rtol=2e-5, atol=2e-5)

@@ -113,6 +113,7 @@ def test_topology_elastic_resume_scale_out(tmp_path):
 
 
 @pytest.mark.timeout(600)
+@pytest.mark.slow
 def test_topology_elastic_llama_loss_continuity(tmp_path):
     """Round-4 verdict task 8: a tiny llama on a 2-axis dp×sharding mesh
     (2 procs × 2 devices = dp2×sh2) crashes after step 1 and resumes on

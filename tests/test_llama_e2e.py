@@ -64,6 +64,7 @@ def _hybrid(dp=1, mp=1, sharding=1, sep=1):
     return hcg
 
 
+@pytest.mark.slow
 def test_single_device_overfits_fixed_batch(single_dev):
     pt.seed(123)
     model = LlamaForCausalLM(tiny_llama_config())
@@ -81,6 +82,7 @@ def test_single_device_overfits_fixed_batch(single_dev):
     assert losses[-1] < losses[0] - 0.5  # memorising one batch must work
 
 
+@pytest.mark.slow
 def test_fsdp_tp_matches_single_device(single_dev):
     ref, _ = _run(single_dev)
     dist.set_hybrid_group(None)
@@ -92,6 +94,7 @@ def test_fsdp_tp_matches_single_device(single_dev):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_zero1_matches_single_device(single_dev):
     ref, _ = _run(single_dev)
     dist.set_hybrid_group(None)
@@ -103,6 +106,7 @@ def test_zero1_matches_single_device(single_dev):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_big_batch(single_dev):
     # accumulate 2 microbatches of 4 == one batch of 8 (mean-of-means holds
     # because every microbatch has the same token count)
@@ -111,12 +115,14 @@ def test_grad_accum_matches_big_batch(single_dev):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_recompute_matches(single_dev):
     ref, _ = _run(single_dev, recompute=False)
     got, _ = _run(single_dev, recompute=True)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sep_axis_runs(single_dev):
     """Context-parallel axis: activations sharded over seq must still match."""
     ref, _ = _run(single_dev)
@@ -248,6 +254,7 @@ def _run_packed(hcg, context_parallel="ring"):
     return losses
 
 
+@pytest.mark.slow
 def test_sep_axis_packed_matches_single_device(single_dev):
     """Varlen × context parallelism (round-3 verdict #2): packed training
     batches under a sep=2 ring must reproduce the single-device packed loss
@@ -262,6 +269,7 @@ def test_sep_axis_packed_matches_single_device(single_dev):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_sep_axis_packed_ulysses_matches_single_device(single_dev):
     ref = _run_packed(single_dev, context_parallel="ulysses")
     dist.set_hybrid_group(None)

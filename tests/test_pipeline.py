@@ -77,16 +77,19 @@ def ref_losses():
     return _reference_losses()
 
 
+@pytest.mark.slow
 def test_pp2_1f1b_matches_reference(ref_losses):
     got = _pipeline_losses(pp=2)
     np.testing.assert_allclose(got, ref_losses, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pp4_fthenb_matches_reference(ref_losses):
     got = _pipeline_losses(pp=4, schedule="FThenB")
     np.testing.assert_allclose(got, ref_losses, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pp2_with_tp_and_dp_matches_reference(ref_losses):
     got = _pipeline_losses(pp=2, dp=2, mp=2)
     np.testing.assert_allclose(got, ref_losses, rtol=2e-4, atol=2e-5)
@@ -109,6 +112,7 @@ def test_pipeline_partition_uniform():
         dist.set_hybrid_group(None)
 
 
+@pytest.mark.slow
 def test_pipeline_eval_batch():
     hcg = dist.HybridCommunicateGroup(pp_degree=2,
                                       devices=jax.devices()[:2])
@@ -125,6 +129,7 @@ def test_pipeline_eval_batch():
         dist.set_hybrid_group(None)
 
 
+@pytest.mark.slow
 def test_pp2_interleave_matches_reference(ref_losses):
     """Interleaved 1F1B (virtual stages): pp=2 x V=2 -> 4 chunks, loss
     parity with the non-pipelined GSPMD reference."""
@@ -151,6 +156,7 @@ def test_pp2_interleave_matches_reference(ref_losses):
         dist.set_hybrid_group(None)
 
 
+@pytest.mark.slow
 def test_pp2_zero3_composes(ref_losses):
     """zero_stage is configurable (round-1 verdict: was hardcoded to 1):
     PP x ZeRO-3 opt-state sharding trains to the same losses."""

@@ -182,6 +182,30 @@ def test_recurrent_models_rejected():
         ServingEngine(model, num_slots=2, max_length=32)
 
 
+def test_idle_step_skips_device_dispatch(lm):
+    """An idle tick (empty queue, no active slots — a server polling for
+    traffic) must return immediately without dispatching the fully-masked
+    decode step to the device."""
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN)
+    real = eng._step_fn
+
+    def boom(*a, **k):
+        raise AssertionError("idle tick dispatched a device decode step")
+
+    eng._step_fn = boom
+    try:
+        for _ in range(3):
+            assert eng.step() == []
+        assert eng.last_occupancy == 0
+        assert eng._ticks == 0          # no device work was even counted
+    finally:
+        eng._step_fn = real
+    # the engine still serves normally after idling
+    p = _prompt(4, seed=91)
+    rid = eng.submit(p, max_new_tokens=2)
+    assert dict(eng.drain())[rid] == _reference(lm, p, 2)
+
+
 def test_per_row_position_decode_matches_scalar(lm):
     """The serving-enabling primitive: decode_step with a per-row
     position VECTOR must equal per-row scalar decode_steps."""
