@@ -45,13 +45,26 @@ import jax
 __all__ = ["CollectiveOrderError", "collective_schedule",
            "check_collective_order"]
 
-# primitive names that lower to cross-replica communication ("psum" traces
-# as "psum_invariant" under the vma type system, jax >= 0.8; "pvary" is a
-# type cast, not comm, and is deliberately absent)
+# primitive names that lower to cross-replica communication.  jax renames
+# these across versions — lax.psum traces as "psum2" under the 0.4.x
+# shard_map rewrite and as "psum_invariant" under the vma type system
+# (jax >= 0.8) — so the lint matches through _CANONICAL instead of
+# pinning one release's strings.  The replication *casts* ("pbroadcast"
+# on 0.4.x, "pvary" on vma jax) move no data and are deliberately absent.
 _COLLECTIVE_PRIMS = {
-    "psum", "psum_invariant", "pmax", "pmin", "pbroadcast", "all_gather",
+    "psum", "psum_invariant", "pmax", "pmin", "all_gather",
     "all_to_all", "ppermute", "reduce_scatter", "psum_scatter", "pgather",
 }
+
+# version-specific primitive name -> the canonical name the schedule
+# reports (and tests pin): the jax-rename-tolerant matching layer
+_CANONICAL = {
+    "psum": "psum_invariant",
+    "psum2": "psum_invariant",
+    "psum_invariant": "psum_invariant",
+    "all_gather_invariant": "all_gather",
+}
+_COLLECTIVE_PRIMS |= set(_CANONICAL)
 
 # params that (a) are not sub-jaxprs and (b) identify the collective
 _ID_PARAMS = ("axes", "axis_name", "axis_index_groups", "perm",
@@ -66,8 +79,44 @@ class CollectiveOrderError(RuntimeError):
 def _sig(eqn) -> Tuple:
     params = {k: v for k, v in eqn.params.items() if k in _ID_PARAMS}
     shapes = tuple(getattr(v.aval, "shape", ()) for v in eqn.invars)
-    return (eqn.primitive.name, tuple(sorted(
+    name = _CANONICAL.get(eqn.primitive.name, eqn.primitive.name)
+    return (name, tuple(sorted(
         (k, str(v)) for k, v in params.items())), shapes)
+
+
+def _install_rep_rule_fallbacks():
+    """jax 0.4.x's shard_map rep-checker has no rule for ``while`` (and
+    raises NotImplementedError at trace time), so linting a while_loop
+    under shard_map — the exact pattern this lint exists to inspect —
+    would explode before the walk even starts.  Register a conservative
+    fallback (outputs replicated over NO axes: never claims a replication
+    it can't prove, so it is sound for any out_specs that mention every
+    mesh axis) for the control-flow primitives the checker is missing.
+    vma-era jax (>= 0.8) has real rules and is left untouched."""
+    try:
+        from jax.experimental import shard_map as _sm
+        rules = getattr(_sm, "_check_rules", None)
+        if rules is None:
+            return
+        import jax.extend.core as _core  # noqa: F401  (presence probe)
+        from jax import lax as _lax
+        for prim_name in ("while_p",):
+            prim = getattr(_lax, prim_name, None)
+            if prim is None:
+                from jax._src.lax import control_flow as _cf
+                prim = getattr(_cf, prim_name, None)
+            if prim is not None and prim not in rules:
+                rules[prim] = lambda mesh, *in_rep, **params: set()
+                # the efficient-transpose rewrite trace keeps a second
+                # rule table; "bind unchanged, rep from the check rule"
+                # is the registered no-op there
+                if hasattr(_sm, "register_norewrite"):
+                    _sm.register_norewrite(prim)
+    except Exception:       # pragma: no cover - newer jax needs nothing
+        pass
+
+
+_install_rep_rule_fallbacks()
 
 
 def _sub_jaxprs(eqn):

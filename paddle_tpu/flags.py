@@ -146,3 +146,21 @@ DEFINE("decode_attention_min_len", 4096,
 DEFINE("decode_attention_block_kv", 512,
        "flash-decode KV chunk size (cap; the kernel picks the largest "
        "128-aligned divisor of max_length at or below it)")
+# paged KV cache (serving/kv_cache.py): the serving engine's block pool
+DEFINE("serving_paged_kv", False,
+       "ServingEngine default cache layout: False = contiguous per-slot "
+       "rows, True = paged block pool with prefix caching (engine "
+       "constructor arg overrides)")
+DEFINE("kv_cache_block_len", 128,
+       "paged KV cache block length in tokens.  128 keeps one block == "
+       "one 128-aligned flash-decode KV chunk so the Pallas kernel can "
+       "dereference block tables in its index maps; non-multiples of 128 "
+       "still work but pin paged attention to the XLA gather path")
+DEFINE("kv_cache_num_blocks", 0,
+       "paged KV pool size in blocks (plus the reserved null block).  0 "
+       "derives num_slots * max_length / block_len — the contiguous "
+       "cache's footprint, now shareable across slots; set lower to "
+       "serve more slots than worst-case memory would allow")
+DEFINE("serving_prefix_cache", True,
+       "register full prompt blocks in the paged cache's prefix trie and "
+       "serve later prompts that share them without recompute")

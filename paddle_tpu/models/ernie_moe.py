@@ -183,6 +183,9 @@ class ErnieMoEModel(Layer):
         """Cache-carrying decode (same stacked-cache layout as LlamaModel;
         see models/generation.py).  Returns (hidden, cache)."""
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        # batch-shard the gathered activations so the SPMD partitioner
+        # never rematerialises the full table per device (MULTICHIP_r02)
+        x = constrain(x, ("dp", "sharding"), None, None)
         rope = (self.rope_cos, self.rope_sin)
         for i, block in enumerate(self.layers):
             x, cache = block.decode(x, rope, pos, cache, i)

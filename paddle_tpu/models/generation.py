@@ -92,7 +92,7 @@ def sample_tokens(logits, key, temperature=0.0, top_k=None, top_p=None):
     return jnp.where(temperature <= 0.0, greedy, samp)
 
 
-def _place_on_mesh(model, params, cache, input_ids):
+def _place_on_mesh(model, params, cache, input_ids, paged_cache=False):
     """Mesh-native decode (round-3 verdict #3): when a hybrid mesh is
     active, lay the decode state out on it before jitting —
 
@@ -148,8 +148,15 @@ def _place_on_mesh(model, params, cache, input_ids):
     batch = tuple(a for a in ("dp", "sharding") if a in names)
     input_ids = jax.device_put(input_ids, ns(batch))
     if isinstance(cache, jax.Array) and cache.ndim == 6:
-        cache = jax.device_put(cache, ns(None, None, batch, None, "mp",
-                                         None))
+        if paged_cache:
+            # paged pool (L, 2, num_blocks, block_len, Hkv, D): any block
+            # can back any slot, so the block axis must NOT be split over
+            # the batch axes — shard kv heads on mp only
+            cache = jax.device_put(cache, ns(None, None, None, None, "mp",
+                                             None))
+        else:
+            cache = jax.device_put(cache, ns(None, None, batch, None, "mp",
+                                             None))
     return params, cache, input_ids
 
 

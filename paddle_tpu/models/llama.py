@@ -175,7 +175,8 @@ class LlamaAttention(Layer):
                                   segment_ids=segment_ids)
         return matmul(out.reshape(b, s, -1), self.o_proj)
 
-    def decode(self, x, rope_cache, pos, cache, idx: int):
+    def decode(self, x, rope_cache, pos, cache, idx: int,
+               block_tables=None):
         """Incremental decode against the STACKED cache
         (L, 2, B, max_len, Hkv, D): write this chunk's K/V in place at
         ``(idx, ·, ·, pos)`` and attend over this layer's slices.
@@ -218,17 +219,58 @@ class LlamaAttention(Layer):
         vector is exactly the live-prefix hint the flash-decode kernel
         consumes — no extra plumbing between the engine and the kernel.
 
+        ``block_tables`` (int (B, max_blocks)) switches to the PAGED
+        cache (serving/kv_cache.py): ``cache`` is the pooled
+        (L, 2, num_blocks, block_len, Hkv, D) array and row i's logical
+        position p lives at physical ``(block_tables[i, p // block_len],
+        p % block_len)``.  Writes become (physical block, offset)
+        scatters; positions past the table's coverage — prompt padding in
+        a prefill-into-slot wave — are steered to the null block (id 0,
+        scratch by convention), so a padded wave can never clobber live
+        or shared blocks.  The attention read hands the table straight to
+        :func:`~paddle_tpu.ops.attention.cached_decode_attention`, whose
+        Pallas kernel dereferences it in the scalar-prefetch index maps.
+        Paged decode always uses per-row positions (a scalar is
+        broadcast).
+
         x: (B, s, H*D).  Returns (out, cache).
         """
         from ..ops.attention import cached_decode_attention
 
         b, s, _ = x.shape
+        paged = block_tables is not None
         per_row = getattr(pos, "ndim", 0) == 1
+        if paged and not per_row:
+            pos = jnp.full((b,), pos, jnp.int32)
+            per_row = True
         if per_row:
             position_ids = pos[:, None] + jnp.arange(s)[None, :]  # (B, s)
         else:
             position_ids = pos + jnp.arange(s)[None, :]
-        q, k, v = self._qkv(x, rope_cache, position_ids)
+        if paged:
+            # prompt-pad positions may run past the RoPE table; clamp for
+            # the rotation only (pad rows' outputs are never consumed)
+            rope_ids = jnp.minimum(position_ids, rope_cache[0].shape[0] - 1)
+        else:
+            rope_ids = position_ids
+        q, k, v = self._qkv(x, rope_cache, rope_ids)
+        if paged:
+            bl = cache.shape[3]
+            max_blocks = block_tables.shape[1]
+            rows = jnp.arange(b)[:, None]                          # (B, 1)
+            lb = position_ids // bl                                # (B, s)
+            phys = jnp.where(
+                lb < max_blocks,
+                block_tables[rows, jnp.minimum(lb, max_blocks - 1)],
+                jnp.int32(0))              # out-of-table pads -> null block
+            off = position_ids % bl
+            cache = cache.at[idx, 0, phys, off].set(k.astype(cache.dtype))
+            cache = cache.at[idx, 1, phys, off].set(v.astype(cache.dtype))
+            q = constrain(q, ("dp", "sharding"), None, "mp", None)
+            cache = constrain(cache, None, None, None, None, "mp", None)
+            out = cached_decode_attention(q, cache[idx, 0], cache[idx, 1],
+                                          pos, block_tables=block_tables)
+            return matmul(out.reshape(b, s, -1), self.o_proj), cache
         if per_row:
             rows = jnp.arange(b)[:, None]                          # (B, 1)
             cache = cache.at[idx, 0, rows, position_ids].set(
@@ -299,9 +341,11 @@ class LlamaDecoderLayer(Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return constrain(x, *_batch_spec(x.ndim))
 
-    def decode(self, x, rope_cache, pos, cache, idx: int):
+    def decode(self, x, rope_cache, pos, cache, idx: int,
+               block_tables=None):
         a, cache = self.self_attn.decode(
-            self.input_layernorm(x), rope_cache, pos, cache, idx)
+            self.input_layernorm(x), rope_cache, pos, cache, idx,
+            block_tables=block_tables)
         x = x + a
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
@@ -346,15 +390,24 @@ class LlamaModel(Layer):
                 x = block(x, rope, position_ids, segment_ids)
         return self.norm(x)
 
-    def decode(self, input_ids, cache, pos):
+    def decode(self, input_ids, cache, pos, block_tables=None):
         """Cache-carrying decode pass.  ``cache``: the stacked
         (L, 2, B, max_len, Hkv, D) array from
-        :func:`paddle_tpu.models.generation.init_kv_cache`; ``pos`` is the
-        number of tokens already in the cache.  Returns (hidden, cache)."""
+        :func:`paddle_tpu.models.generation.init_kv_cache` — or, with
+        ``block_tables``, the pooled paged cache from
+        :func:`paddle_tpu.serving.kv_cache.init_paged_kv_cache`; ``pos``
+        is the number of tokens already in the cache.  Returns
+        (hidden, cache)."""
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        # constrain the gathered activations (batch over dp×sharding) so
+        # the SPMD partitioner shards the lookup output instead of falling
+        # back to rematerialising the full embedding table per device
+        # (the gather-on-sharded-dim cliff recorded in MULTICHIP_r02)
+        x = constrain(x, ("dp", "sharding"), None, None)
         rope = (self.rope_cos, self.rope_sin)
         for i, block in enumerate(self.layers):
-            x, cache = block.decode(x, rope, pos, cache, i)
+            x, cache = block.decode(x, rope, pos, cache, i,
+                                    block_tables=block_tables)
         return self.norm(x), cache
 
 
@@ -412,11 +465,14 @@ class LlamaForCausalLM(Layer):
         return causal_lm_loss(
             self.forward(input_ids, position_ids, segment_ids), labels)
 
-    def decode_step(self, input_ids, cache, pos):
+    def decode_step(self, input_ids, cache, pos, block_tables=None):
         """(logits, cache): one cache-carrying decode step (prefill when
         ``input_ids`` is the whole prompt at pos=0, incremental when it is
-        the last token).  See models/generation.py for the cache layout."""
-        hidden, cache = self.model.decode(input_ids, cache, pos)
+        the last token).  See models/generation.py for the cache layout,
+        serving/kv_cache.py for the paged layout ``block_tables``
+        selects."""
+        hidden, cache = self.model.decode(input_ids, cache, pos,
+                                          block_tables=block_tables)
         return self.logits(hidden), cache
 
     def generate(self, input_ids, max_new_tokens: int = 32, **kw):

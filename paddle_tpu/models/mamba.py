@@ -232,6 +232,9 @@ class Mamba2ForCausalLM(Layer):
         kept for the shared generation-loop signature."""
         del pos
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        # batch-shard the gathered activations so the SPMD partitioner
+        # never rematerialises the full table per device (MULTICHIP_r02)
+        x = constrain(x, ("dp", "sharding"), None, None)
         conv, ssm = state["conv"], state["ssm"]
         for i, blk in enumerate(self.layers):
             x, c_i, s_i = blk.decode(x, conv[i], ssm[i])

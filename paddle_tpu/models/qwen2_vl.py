@@ -270,6 +270,9 @@ class Qwen2VLForConditionalGeneration(Layer):
         layers just re-attend the new tokens to them each step (q_len ∈
         {1, prompt}); only self-attention carries the stacked KV cache."""
         x = vocab_parallel_lookup(self.embed_tokens, input_ids)
+        # batch-shard the gathered activations so the SPMD partitioner
+        # never rematerialises the full table per device (MULTICHIP_r02)
+        x = constrain(x, ("dp", "sharding"), None, None)
         rope = (self.rope_cos, self.rope_sin)
         for i, blk in enumerate(self.layers):
             x, cache = blk.decode(x, rope, pos, cache, i)

@@ -217,6 +217,9 @@ class RwkvForCausalLM(Layer):
     def decode_step(self, input_ids, state, pos):
         del pos  # no positional encoding in the RNN family
         x = vocab_parallel_lookup(self.embeddings, input_ids)
+        # batch-shard the gathered activations so the SPMD partitioner
+        # never rematerialises the full table per device (MULTICHIP_r02)
+        x = constrain(x, ("dp", "sharding"), None, None)
         x = self.ln_pre(x)
         new = {k: v for k, v in state.items()}
         for i, blk in enumerate(self.blocks):

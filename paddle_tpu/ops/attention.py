@@ -114,10 +114,16 @@ def flash_attention_reference(q, k, v, attn_mask=None, dropout_p: float = 0.0,
 
 
 def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
-                          kv_len: int, has_extra_mask: bool = False):
+                          kv_len: int, has_extra_mask: bool = False,
+                          paged_block_len: Optional[int] = None):
     """The flash-decode dispatch decision for one shape, exposed so
     bench.py can record the chosen path per row: returns
     ``("pallas_decode", None)`` or ``("xla_math", reason)``.
+
+    ``paged_block_len``: set when the cache is the paged block pool
+    (serving/kv_cache.py) — the kernel then pins its KV chunk to one
+    block, so the block length must be 128-aligned; ``kv_len`` is the
+    LOGICAL length ``max_blocks · block_len``.
 
     Threshold provenance (BENCH_DECODE.json, 940M llama3-arch, v5e): the
     XLA math path sits AT the bf16 weight-stream bound through
@@ -142,6 +148,11 @@ def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
         return "xla_math", f"s*G = {s * (hq // hkv)} > 64 (prefill-shaped)"
     if d > 256:
         return "xla_math", f"head_dim {d} > 256"
+    if paged_block_len is not None:
+        if paged_block_len % 128:
+            return "xla_math", (f"paged block_len {paged_block_len} not "
+                                f"128-aligned")
+        return "pallas_decode", None
     if kv_len % 128:
         return "xla_math", f"max_length {kv_len} not 128-aligned"
     return "pallas_decode", None
@@ -149,7 +160,8 @@ def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
 
 def cached_decode_attention(q, k_cache, v_cache, pos,
                             scale: Optional[float] = None,
-                            extra_mask=None, live_len: Optional[int] = None):
+                            extra_mask=None, live_len: Optional[int] = None,
+                            block_tables=None):
     """Incremental decode attention over a pre-allocated cache — the
     serving hot path (parity: the reference's masked_multihead_attention /
     fused decode-attention core, upstream
@@ -172,21 +184,36 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
     ``extra_mask``) runs :func:`cached_decode_attention_reference`, the
     XLA math path, which the decode bench measured at the weight-stream
     bound for short caches.  Returns (B, s, Hq, D) in q.dtype.
+
+    ``block_tables``: int (B, max_blocks) — switches to the PAGED cache
+    layout (serving/kv_cache.py): k_cache/v_cache are the pooled
+    (num_blocks, block_len, Hkv, D) arrays and row i's logical block j
+    lives in physical block ``block_tables[i, j]``.  The Pallas kernel
+    dereferences the table in its scalar-prefetch index maps; the XLA
+    fallback gathers the table into the contiguous layout first.
     """
     b, s, hq, d = q.shape
-    _, kv_len, hkv, _ = k_cache.shape
-    path, reason = decode_attention_path(b, s, hq, hkv, d, kv_len,
-                                         extra_mask is not None)
+    if block_tables is not None:
+        _, block_len, hkv, _ = k_cache.shape
+        kv_len = block_tables.shape[1] * block_len
+        path, reason = decode_attention_path(b, s, hq, hkv, d, kv_len,
+                                             extra_mask is not None,
+                                             paged_block_len=block_len)
+    else:
+        _, kv_len, hkv, _ = k_cache.shape
+        path, reason = decode_attention_path(b, s, hq, hkv, d, kv_len,
+                                             extra_mask is not None)
     if path == "pallas_decode":
         try:
             from .pallas.decode_attention import decode_attention_pallas
             return decode_attention_pallas(
                 q, k_cache, v_cache, pos, scale=scale, live_len=live_len,
+                block_tables=block_tables,
                 interpret=_dispatch.pallas_interpret())
         except NotImplementedError as e:
             reason = str(e)
     if _dispatch.use_pallas() and not reason.startswith(
-            ("no Pallas", "kv_len", "extra_mask")):
+            ("no Pallas", "kv_len", "extra_mask", "paged block_len")):
         # an above-threshold shape falling back IS a perf surprise worth
         # one log line; below-threshold / masked shapes are the design
         vlog_once(1, f"decode_attention:{reason}",
@@ -195,13 +222,15 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
     return cached_decode_attention_reference(q, k_cache, v_cache, pos,
                                              scale=scale,
                                              extra_mask=extra_mask,
-                                             live_len=live_len)
+                                             live_len=live_len,
+                                             block_tables=block_tables)
 
 
 def cached_decode_attention_reference(q, k_cache, v_cache, pos,
                                       scale: Optional[float] = None,
                                       extra_mask=None,
-                                      live_len: Optional[int] = None):
+                                      live_len: Optional[int] = None,
+                                      block_tables=None):
     """The XLA math path of :func:`cached_decode_attention` (and its
     numerical oracle): masked softmax over the whole cache read.
 
@@ -221,8 +250,27 @@ def cached_decode_attention_reference(q, k_cache, v_cache, pos,
     regime at short max_length; its per-step cost is O(S·max_len) —
     streaming the dead cache tail — which is what the flash-decode
     kernel's live-prefix reads fix at long max_length.
+
+    ``block_tables`` (int (B, max_blocks)): PAGED layout — k_cache/
+    v_cache are the pooled (num_blocks, block_len, Hkv, D) arrays; the
+    per-row physical blocks are gathered into the contiguous
+    (B, max_blocks·block_len, Hkv, D) view first (an HBM copy — this is
+    the parity oracle and the small-shape fallback, not the long-cache
+    hot path), after which the math is identical.  A ``live_len`` bound
+    trims whole table columns before the gather.
     """
     b, s, hq, d = q.shape
+    if block_tables is not None:
+        _, bl, hkv_p, _ = k_cache.shape
+        mb = block_tables.shape[1]
+        if live_len is not None and live_len < mb * bl:
+            mb = -(-int(live_len) // bl)
+            block_tables = block_tables[:, :mb]
+        # (B, mb) pool gather -> (B, mb, bl, Hkv, D) -> contiguous view
+        k_cache = jnp.take(k_cache, block_tables, axis=0,
+                           mode="clip").reshape(b, mb * bl, hkv_p, d)
+        v_cache = jnp.take(v_cache, block_tables, axis=0,
+                           mode="clip").reshape(b, mb * bl, hkv_p, d)
     if live_len is not None and live_len < k_cache.shape[1]:
         k_cache = k_cache[:, :live_len]
         v_cache = v_cache[:, :live_len]

@@ -42,6 +42,24 @@ fully-masked-row convention as the flash kernel (out = 0).
 The cross-chunk merge is the same LSE algebra the ring-attention path
 uses (ops/ring_attention.py ``merge_attention``), specialised to the
 running (m, l, acc) form since chunks arrive sequentially.
+
+**Paged KV cache** (serving/kv_cache.py): the kernel also serves the
+block-table layout, where the cache is one pooled ``(num_blocks,
+block_len, Hkv, D)`` array and each row's logical positions are backed by
+the physical blocks its ``(B, max_blocks)`` block table names.  The table
+rides in as a SECOND scalar-prefetch operand and the KV-chunk index maps
+dereference it: grid step (bi, ki) DMAs physical block
+``table[bi, min(ki, last_live)]``.  One KV chunk == one cache block
+(``block_len`` must be 128-aligned), so a block is one contiguous DMA
+exactly as before, blocks may be scattered anywhere in the pool, shared
+between rows, or partially filled (the in-kernel ``pos`` mask already
+handles partial blocks — column indices are logical).  The contiguous
+layout is the degenerate case: the caller's cache reshapes to a
+``(B·chunks, bk, Hkv·D)`` pool (a free view) under the identity table
+``table[bi, ki] = bi·chunks + ki``, which is how PR 2's dead-tail
+clamping now reads — clamping the logical chunk index before the table
+lookup maps dead-tail grid steps to the row's last live block, the DMA is
+elided, and HBM traffic still stops at the live prefix.
 """
 
 from __future__ import annotations
@@ -70,8 +88,9 @@ def _pick_block_kv(kv_len: int, cap: int) -> int:
     return 0
 
 
-def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc, *,
-            scale, s, g, hkv, d, rows, rows_p, bk, chunks):
+def _kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
+            l_sc, *, scale, s, g, hkv, d, rows, rows_p, bk, chunks):
+    del bt_ref  # consumed by the index maps, not the body
     bi = pl.program_id(0)
     ki = pl.program_id(1)
     pos_b = pos_ref[bi]
@@ -126,13 +145,25 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
                             scale: Optional[float] = None,
                             block_kv: int = 0,
                             live_len: Optional[int] = None,
-                            interpret: bool = False):
+                            interpret: bool = False,
+                            block_tables=None):
     """Flash-decode over a pre-allocated cache → (B, s, Hq, D) in q.dtype.
 
     q: (B, s, Hq, D) new-token queries (s = 1 in steady-state decode,
-    small for prefill-into-occupied-slot); k_cache/v_cache:
-    (B, L, Hkv, D) with the new K/V already written; ``pos``: scalar or
-    int (B,) per-row positions — cache slots > pos+i are masked.
+    small for prefill-into-occupied-slot); ``pos``: scalar or int (B,)
+    per-row positions — cache slots > pos+i are masked.  Two cache
+    layouts:
+
+      * **contiguous** (``block_tables`` is None): k_cache/v_cache are
+        (B, L, Hkv, D) with the new K/V already written;
+      * **paged**: k_cache/v_cache are the pooled (num_blocks, block_len,
+        Hkv, D) arrays and ``block_tables`` is the int (B, max_blocks)
+        map from each row's logical block index to its physical block
+        (serving/kv_cache.py conventions: every entry valid, dead tail
+        null-filled).  The logical cache length is
+        ``max_blocks · block_len`` and the KV chunk is pinned to one
+        block, so ``block_len`` must be 128-aligned.
+
     ``live_len``: optional static bound on max(pos)+s (trims the chunk
     grid outright; without it the scalar-prefetch clamp stops the HBM
     streaming at each row's live prefix dynamically).  Raises
@@ -140,7 +171,18 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
     fall back to the XLA math path).
     """
     b, s, hq, d = q.shape
-    _, kv_len, hkv, _ = k_cache.shape
+    if block_tables is not None:
+        n_pool, bk, hkv, _ = k_cache.shape
+        if bk % 128:
+            raise NotImplementedError(
+                f"paged block_len {bk} is not 128-aligned")
+        bt = jnp.asarray(block_tables, jnp.int32)
+        kv_len = bt.shape[1] * bk
+        # pool layout: one physical block == one KV chunk == one DMA
+        k2 = k_cache.reshape(n_pool, bk, hkv * d)
+        v2 = v_cache.reshape(n_pool, bk, hkv * d)
+    else:
+        _, kv_len, hkv, _ = k_cache.shape
     if hq % hkv or hkv == 0:
         raise NotImplementedError(
             f"q heads ({hq}) must be a multiple of kv heads ({hkv})")
@@ -154,14 +196,23 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         raise NotImplementedError(f"head_dim {d} > 256")
     if scale is None:
         scale = d ** -0.5
-    if not block_kv:
-        from ...flags import flag
-        block_kv = int(flag("decode_attention_block_kv"))
-    bk = _pick_block_kv(kv_len, block_kv)
-    if not bk:
-        raise NotImplementedError(
-            f"max_length {kv_len} has no 128-aligned chunk divisor "
-            f"<= {block_kv}")
+    if block_tables is None:
+        if not block_kv:
+            from ...flags import flag
+            block_kv = int(flag("decode_attention_block_kv"))
+        bk = _pick_block_kv(kv_len, block_kv)
+        if not bk:
+            raise NotImplementedError(
+                f"max_length {kv_len} has no 128-aligned chunk divisor "
+                f"<= {block_kv}")
+        # contiguous = paged under the identity table: view the cache as a
+        # (B·chunks, bk, Hkv·D) pool (free reshape) with table
+        # [bi, ki] = bi·chunks + ki — same DMAs, one code path
+        full = kv_len // bk
+        bt = (jnp.arange(b, dtype=jnp.int32)[:, None] * full
+              + jnp.arange(full, dtype=jnp.int32)[None, :])
+        k2 = k_cache.reshape(b * full, bk, hkv * d)
+        v2 = v_cache.reshape(b * full, bk, hkv * d)
     chunks = kv_len // bk
     if live_len is not None:
         chunks = max(1, min(chunks, -(-int(live_len) // bk)))
@@ -175,33 +226,33 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         b, hkv, rows, d)
     if rows_p != rows:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_p - rows), (0, 0)))
-    # native cache layout, viewed flat so a KV chunk is one contiguous DMA
-    k2 = k_cache.reshape(b, kv_len, hkv * d)
-    v2 = v_cache.reshape(b, kv_len, hkv * d)
 
     kernel = functools.partial(
         _kernel, scale=float(scale), s=s, g=g, hkv=hkv, d=d, rows=rows,
         rows_p=rows_p, bk=bk, chunks=chunks)
 
-    def kv_idx(bi, ki, pos_ref):
-        # dead-tail chunks re-map to the last live block: same index as
-        # the previous grid step → Pallas elides the DMA, so HBM traffic
-        # stops at this row's live prefix
-        return (bi, jnp.minimum(ki, (pos_ref[bi] + s - 1) // bk), 0)
+    def kv_idx(bi, ki, pos_ref, bt_ref):
+        # clamp the LOGICAL chunk index to the row's last live block, then
+        # dereference the block table: dead-tail chunks re-map to the same
+        # physical block as the previous grid step → Pallas elides the
+        # DMA, so HBM traffic stops at this row's live prefix
+        return (bt_ref[bi, jnp.minimum(ki, (pos_ref[bi] + s - 1) // bk)],
+                0, 0)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(b, chunks),
             in_specs=[
                 pl.BlockSpec((1, hkv, rows_p, d),
-                             lambda bi, ki, pos_ref: (bi, 0, 0, 0)),
+                             lambda bi, ki, pos_ref, bt_ref: (bi, 0, 0, 0)),
                 pl.BlockSpec((1, bk, hkv * d), kv_idx),
                 pl.BlockSpec((1, bk, hkv * d), kv_idx),
             ],
-            out_specs=pl.BlockSpec((1, hkv, rows_p, d),
-                                   lambda bi, ki, pos_ref: (bi, 0, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (1, hkv, rows_p, d),
+                lambda bi, ki, pos_ref, bt_ref: (bi, 0, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((hkv, rows_p, d), jnp.float32),
                 pltpu.VMEM((hkv, rows_p, _LANES), jnp.float32),
@@ -212,6 +263,6 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(pos_arr, qg, k2, v2)
+    )(pos_arr, bt, qg, k2, v2)
     out = out[:, :, :rows].reshape(b, hkv, s, g, d).transpose(0, 2, 1, 3, 4)
     return out.reshape(b, s, hq, d).astype(q.dtype)

@@ -12,5 +12,7 @@ admits queued requests into freed slots mid-flight.
 """
 
 from .engine import Request, SamplingParams, ServingEngine
+from .kv_cache import BlockManager, init_paged_kv_cache
 
-__all__ = ["ServingEngine", "SamplingParams", "Request"]
+__all__ = ["ServingEngine", "SamplingParams", "Request", "BlockManager",
+           "init_paged_kv_cache"]

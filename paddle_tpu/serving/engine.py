@@ -41,6 +41,31 @@ sampling implementation, same cache layout — greedy engine outputs are
 token-identical to ``greedy_generate`` (tests/test_serving.py asserts
 this across admission orders).  ``generate()`` remains the right tool for
 offline parity/eval batches; the engine is the right tool for traffic.
+
+**Paged mode** (``paged=True`` / FLAGS_serving_paged_kv): the per-slot
+cache rows are replaced by the kv_cache.py block pool — one
+``(L, 2, num_blocks, block_len, Hkv, D)`` array plus a host-side
+:class:`~paddle_tpu.serving.kv_cache.BlockManager`.  What changes and
+what doesn't:
+
+  * the step function signature gains one tiny traced input, the
+    ``(num_slots, max_blocks)`` block table; it is still jitted ONCE —
+    allocation churn moves data through that input, never a retrace;
+  * HBM cost becomes live tokens + shared prefixes instead of
+    ``num_slots × max_length``: blocks are allocated lazily as slots
+    deepen (admission reserves the worst case so mid-flight allocation
+    can't fail), retired prompt blocks stay cached for prefix hits until
+    pool pressure evicts them LRU-first;
+  * admission consults the prefix trie: a request whose prompt opens with
+    already-cached full blocks adopts them (refcount, zero recompute) and
+    prefill runs ONLY the suffix — a shared system prompt is computed and
+    stored once, which the manager's hit counters prove;
+  * prefill therefore runs as decode-at-depth on the pool itself (per-row
+    ``pos`` = adopted prefix length) rather than on a fresh pos=0
+    sub-cache — it takes the cached-attention path, not the flash-prefill
+    kernel; the trade is recompute avoided vs kernel choice, and it wins
+    whenever prefixes actually repeat.  Greedy outputs stay
+    token-identical to the contiguous engine (tests/test_serving_paged.py).
 """
 
 from __future__ import annotations
@@ -53,8 +78,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import flags as _flags
 from ..models.generation import _place_on_mesh, init_kv_cache, sample_tokens
 from ..nn.layer import bind_params
+from .kv_cache import BlockManager, init_paged_kv_cache
 
 __all__ = ["ServingEngine", "SamplingParams", "Request"]
 
@@ -99,7 +126,17 @@ class ServingEngine:
 
     def __init__(self, model, num_slots: int = 8, max_length: int = 1024,
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
-                 prefill_batch: int = 4, seed: int = 0):
+                 prefill_batch: int = 4, seed: int = 0,
+                 paged: Optional[bool] = None,
+                 block_len: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
+        """``paged`` (default FLAGS_serving_paged_kv) selects the paged
+        block-pool cache; ``block_len`` (FLAGS_kv_cache_block_len) and
+        ``num_blocks`` (FLAGS_kv_cache_num_blocks; 0 derives the
+        contiguous cache's footprint, num_slots·max_length/block_len,
+        plus the null block) size it; ``prefix_cache``
+        (FLAGS_serving_prefix_cache) toggles prompt-prefix sharing."""
         if hasattr(model, "init_decode_state"):
             raise NotImplementedError(
                 "ServingEngine requires the stacked KV cache; recurrent "
@@ -116,15 +153,43 @@ class ServingEngine:
         self.eos_token_id = eos_token_id
         self.pad_token_id = int(pad_token_id)
         self.prefill_batch = int(prefill_batch)
+        self.paged = bool(_flags.flag("serving_paged_kv")
+                          if paged is None else paged)
 
         # quantized-decode hooks, exactly as models/generation.py binds
         self._bind = getattr(model, "unwrapped", model)
         self._prepare = getattr(model, "_prepare_params", lambda p: p)
         params = model.state_dict(include_buffers=True)
-        cache = init_kv_cache(model.config, self.num_slots, self.max_length)
+        if self.paged:
+            bl = int(block_len or _flags.flag("kv_cache_block_len"))
+            if self.max_length % bl:
+                raise ValueError(
+                    f"max_length {self.max_length} is not a multiple of "
+                    f"block_len {bl}")
+            self.block_len = bl
+            self.max_blocks = self.max_length // bl
+            nb = int(num_blocks or _flags.flag("kv_cache_num_blocks")
+                     or self.num_slots * self.max_blocks + 1)
+            self.kv = BlockManager(
+                nb, bl,
+                prefix_cache=bool(_flags.flag("serving_prefix_cache")
+                                  if prefix_cache is None else prefix_cache))
+            cache = init_paged_kv_cache(model.config, nb, bl)
+            self._tables = np.zeros((self.num_slots, self.max_blocks),
+                                    np.int32)
+            # COW device copy (compiled once; only dispatched when a
+            # shared block is about to be written — see kv_cache.py)
+            self._cow_fn = jax.jit(
+                lambda c, src, dst: c.at[:, :, dst].set(c[:, :, src]))
+            self.prefill_tokens_computed = 0   # pads excluded; proves the
+            self.prefill_tokens_total = 0      # prefix cache skips work
+        else:
+            cache = init_kv_cache(model.config, self.num_slots,
+                                  self.max_length)
         params, cache, _ = _place_on_mesh(
             self._bind, params, cache,
-            jnp.zeros((self.num_slots, 1), jnp.int32))
+            jnp.zeros((self.num_slots, 1), jnp.int32),
+            paged_cache=self.paged)
         self._params, self._cache = params, cache
 
         # host-side mirrors of the step inputs (tiny; re-uploaded per tick)
@@ -148,8 +213,12 @@ class ServingEngine:
         # claim is testable (tests assert step_traces == 1)
         self.step_traces = 0
         self.prefill_traces = 0
-        self._step_fn = jax.jit(self._step_impl)
-        self._prefill_fn = jax.jit(self._prefill_impl)
+        if self.paged:
+            self._step_fn = jax.jit(self._step_impl_paged)
+            self._prefill_fn = jax.jit(self._prefill_impl_paged)
+        else:
+            self._step_fn = jax.jit(self._step_impl)
+            self._prefill_fn = jax.jit(self._prefill_impl)
 
     # -- jitted device programs -------------------------------------------
 
@@ -184,6 +253,41 @@ class ServingEngine:
         cache = cache.at[:, :, slot_ids].set(sub, mode="drop")
         return tok, cache
 
+    def _step_impl_paged(self, params, cache, tokens, positions, tables,
+                         slot_mask, temps, topk, topp, key):
+        """Paged twin of ``_step_impl``: identical but the block table
+        rides along as a traced input, so allocation changes (slots
+        deepening into fresh blocks, prefix adoptions, evictions) reach
+        the device as data.  Compiled exactly once."""
+        self.step_traces += 1
+        with bind_params(self._bind, self._prepare(params)):
+            logits, cache = self.model.decode_step(
+                tokens[:, None], cache, positions, block_tables=tables)
+        nxt = sample_tokens(logits[:, -1], key, temps, topk, topp)
+        nxt = jnp.where(slot_mask, nxt, jnp.int32(self.pad_token_id))
+        return nxt, cache
+
+    def _prefill_impl_paged(self, params, cache, ids, prefix_lens,
+                            suffix_lens, tables, temps, topk, topp, key):
+        """Paged prefill of one admission wave: each row computes ONLY
+        its prompt suffix — the tokens its prefix-cache match did not
+        cover — as a decode-at-depth over the pool (per-row ``pos`` =
+        adopted prefix length; the adopted blocks are read, not
+        recomputed).  Writes scatter straight into the rows' own blocks
+        (kv_cache.py's null-block convention absorbs bucket padding, and
+        rows admitted in the same wave see each other's writes because
+        every layer's scatter precedes its attention read).  The first
+        token samples from the logits at each row's last REAL suffix
+        position.  One compilation per padded suffix-bucket length."""
+        self.prefill_traces += 1
+        nb = ids.shape[0]
+        with bind_params(self._bind, self._prepare(params)):
+            logits, cache = self.model.decode_step(
+                ids, cache, prefix_lens, block_tables=tables)
+        last = logits[jnp.arange(nb), suffix_lens - 1]     # (nb, vocab)
+        tok = sample_tokens(last, key, temps, topk, topp)
+        return tok, cache
+
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt: Sequence[int],
@@ -202,6 +306,12 @@ class ServingEngine:
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the engine's max_length "
                 f"({self.max_length})")
+        if self.paged:
+            need = self.kv.blocks_needed(prompt.size, max_new_tokens)
+            if need > self.kv.usable_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool only "
+                    f"has {self.kv.usable_blocks} usable blocks")
         rid = self._next_rid
         self._next_rid += 1
         self._results[rid] = []
@@ -226,11 +336,34 @@ class ServingEngine:
             return finished
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
-        nxt, self._cache = self._step_fn(
-            self._params, self._cache,
-            jnp.asarray(self._tokens), jnp.asarray(self._positions),
-            jnp.asarray(self._active), jnp.asarray(self._temps),
-            jnp.asarray(self._topk), jnp.asarray(self._topp), key)
+        if self.paged:
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                # this tick writes K/V at positions[i]: grow the chain
+                # over the block boundary and COW-privatise it (a no-op
+                # unless a forking feature shared the tail block)
+                pos = int(self._positions[i])
+                grew = self.kv.ensure_capacity(i, pos)
+                cow = self.kv.ensure_writable(i, pos // self.block_len)
+                if cow is not None:
+                    self._cache = self._cow_fn(self._cache,
+                                               jnp.int32(cow[0]),
+                                               jnp.int32(cow[1]))
+                if grew or cow is not None:
+                    self._tables[i] = self.kv.table_row(i, self.max_blocks)
+            nxt, self._cache = self._step_fn(
+                self._params, self._cache,
+                jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                jnp.asarray(self._tables), jnp.asarray(self._active),
+                jnp.asarray(self._temps), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), key)
+        else:
+            nxt, self._cache = self._step_fn(
+                self._params, self._cache,
+                jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                jnp.asarray(self._active), jnp.asarray(self._temps),
+                jnp.asarray(self._topk), jnp.asarray(self._topp), key)
         nxt = np.asarray(nxt)
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -282,6 +415,8 @@ class ServingEngine:
         """Move queued requests into free slots, one batched-prefill wave
         per contiguous FIFO run sharing a bucket.  Returns ids that
         finished AT admission (first token was EOS / max_new_tokens=1)."""
+        if self.paged:
+            return self._admit_paged()
         finished: List[int] = []
         while self._queue:
             free = [i for i, s in enumerate(self._slots) if s is None]
@@ -297,6 +432,83 @@ class ServingEngine:
                 wave.append(self._queue.popleft())
             finished.extend(self._prefill_wave(wave, free[:len(wave)],
                                                bucket))
+        return finished
+
+    def _admit_paged(self) -> List[int]:
+        """Paged admission: FIFO requests enter free slots once the block
+        pool covers their worst case (kv_cache.py reservations), adopting
+        any cached prompt prefix on the way in.  A wave shares one padded
+        SUFFIX bucket (prefix-hit rows only compute what the cache
+        missed).  The FIFO head blocking on pool space blocks the queue —
+        head-of-line order is the contiguous engine's contract too."""
+        finished: List[int] = []
+        while self._queue:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                break
+            wave: List[Tuple[Request, int, int]] = []
+            while (self._queue
+                   and len(wave) < min(self.prefill_batch, len(free))):
+                req = self._queue[0]
+                si = free[len(wave)]
+                m = self.kv.admit(si, req.prompt, req.prompt.size,
+                                  req.max_new_tokens)
+                if m is None:          # pool full: wait for retirements
+                    break
+                self._queue.popleft()
+                self._tables[si] = self.kv.table_row(si, self.max_blocks)
+                wave.append((req, si, m))
+            if not wave:
+                break
+            finished.extend(self._prefill_wave_paged(wave))
+        return finished
+
+    def _prefill_wave_paged(self, wave: List[Tuple[Request, int, int]]
+                            ) -> List[int]:
+        nb = self.prefill_batch
+        bucket = min(max(self._bucket(req.prompt.size - m)
+                         for req, _, m in wave), self.max_length)
+        ids = np.full((nb, bucket), self.pad_token_id, np.int32)
+        prefix = np.zeros((nb,), np.int32)
+        slens = np.ones((nb,), np.int32)
+        # dummy rows keep all-null tables: their writes land in the
+        # scratch block and their sampled token is discarded
+        tables = np.zeros((nb, self.max_blocks), np.int32)
+        temps = np.zeros((nb,), np.float32)
+        topk = np.zeros((nb,), np.int32)
+        topp = np.ones((nb,), np.float32)
+        for r, (req, si, m) in enumerate(wave):
+            suffix = req.prompt[m:]
+            ids[r, :suffix.size] = suffix
+            prefix[r] = m
+            slens[r] = suffix.size
+            tables[r] = self._tables[si]
+            temps[r] = req.sampling.temperature
+            topk[r] = req.sampling.top_k
+            topp[r] = req.sampling.top_p
+            self.prefill_tokens_computed += int(suffix.size)
+            self.prefill_tokens_total += int(req.prompt.size)
+        self._ticks += 1
+        key = jax.random.fold_in(self._base_key, self._ticks)
+        tok, self._cache = self._prefill_fn(
+            self._params, self._cache, jnp.asarray(ids),
+            jnp.asarray(prefix), jnp.asarray(slens), jnp.asarray(tables),
+            jnp.asarray(temps), jnp.asarray(topk), jnp.asarray(topp), key)
+        tok = np.asarray(tok)
+        finished: List[int] = []
+        for r, (req, si, m) in enumerate(wave):
+            slot = _Slot(req.request_id, req.max_new_tokens - 1)
+            self._slots[si] = slot
+            self._active[si] = True
+            self._tokens[si] = tok[r]
+            self._positions[si] = req.prompt.size
+            self._temps[si] = temps[r]
+            self._topk[si] = topk[r]
+            self._topp[si] = topp[r]
+            self._results[req.request_id].append(int(tok[r]))
+            if self._done(int(tok[r]), slot, si):
+                finished.append(req.request_id)
+                self._release(si)
         return finished
 
     def _prefill_wave(self, wave: List[Request], slots: List[int],
@@ -346,6 +558,9 @@ class ServingEngine:
                 or int(self._positions[i]) >= self.max_length)
 
     def _release(self, i: int):
+        if self.paged:
+            self.kv.release(i)
+            self._tables[i] = 0
         self._slots[i] = None
         self._active[i] = False
         self._tokens[i] = self.pad_token_id

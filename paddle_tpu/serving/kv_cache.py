@@ -1,0 +1,353 @@
+"""Paged KV cache — block allocator + prefix cache for the serving engine.
+
+The vLLM PagedAttention memory model, TPU-shaped: instead of one
+contiguous ``(max_length, Hkv, D)`` cache row per slot (capacity paid at
+worst-case length, identical system prompts stored once per request), the
+device cache is ONE pooled array ``(L, 2, num_blocks, block_len, Hkv, D)``
+of fixed-size KV blocks, and each slot owns a *block table* — the ordered
+list of physical block ids that back its logical token positions.  Cache
+cost becomes ``live tokens + shared prefixes`` instead of
+``num_slots × max_length``.
+
+Division of labour:
+
+  * **this module is pure host-side bookkeeping** — a free-list allocator,
+    per-slot block chains, refcounts, a prefix trie, an eviction LRU, and
+    the numpy block-table rows the engine uploads each tick.  Nothing here
+    touches the device; the pool array itself is created by
+    :func:`init_paged_kv_cache` and carried through the engine's jitted
+    step exactly like the contiguous cache (the block table rides along as
+    a tiny traced ``(num_slots, max_blocks)`` int32 input, so allocation
+    changes never retrace);
+  * the device-side dereference lives in the attention paths: the Pallas
+    flash-decode kernel takes the table as a second scalar-prefetch
+    operand and its KV-chunk index maps look physical blocks up *before*
+    each grid step (ops/pallas/decode_attention.py), and the XLA math path
+    gathers ``pool[block_table]`` into the contiguous layout
+    (ops/attention.py).  Writes are batched scatters to
+    ``(physical_block, offset)`` pairs (models/llama.py ``decode``).
+
+Conventions the device side relies on:
+
+  * **block 0 is the null block** — never allocated to a request.  Block
+    tables are zero-filled beyond a slot's allocated chain, so every table
+    entry is always a valid physical index: reads of the dead tail land in
+    scratch (and are masked by position anyway), and writes from prompt
+    padding are steered to the null block instead of needing a dropped
+    scatter.  Its contents are junk by design;
+  * a slot's table covers positions ``[0, len(chain) · block_len)``; the
+    engine guarantees the block holding position ``pos + s - 1`` is
+    allocated before any step that reads or writes it (``ensure_capacity``
+    runs on the host before dispatch);
+  * full *prompt* blocks are immutable once written (generation appends at
+    positions ≥ prompt length, which live in later blocks) — that is what
+    makes them safely shareable and trie-cacheable without copies.
+
+Prefix cache: full prompt blocks are registered in a chain-keyed trie
+(``(parent_block_id, block tokens) -> block_id``, the vLLM hash-chain
+scheme with exact keys instead of hashes).  A later request whose prompt
+starts with the same token blocks *adopts* the existing chain — refcount
+bump, zero recompute, zero new HBM — and its prefill runs only the
+suffix.  Matching is capped at ``(plen - 1) // block_len`` blocks so at
+least one real token always remains to produce the first logits.  Retired
+chains whose blocks are trie-registered are kept (refcount 0) on an LRU
+list and revived on later hits; allocation under pressure evicts the LRU
+head, cascading the trie unregistration through its descendants so a
+reused block id can never satisfy a stale lookup.
+
+Copy-on-write: ``ensure_writable`` is the guard a writer calls before
+mutating a block mid-chain — if the block is shared (refcount > 1) it is
+swapped for a fresh private copy and the (src, dst) pair is returned so
+the caller can issue the device copy.  In the current engine flow full
+blocks are immutable and tail blocks are private, so this never fires;
+it is the hook forking features (beam/speculative decode, n>1 sampling)
+build on, and it is unit-tested at this layer.
+
+Admission is reservation-based so mid-flight allocation cannot fail: a
+request is admitted only if ``free + evictable - already-reserved`` covers
+every block it could ever need (prompt + max_new_tokens, minus the shared
+prefix); the reservation is consumed block-by-block as the sequence
+deepens and released with the slot.  There is no fragmentation (any free
+block serves any slot), so the check is exact.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+__all__ = ["BlockManager", "NULL_BLOCK", "init_paged_kv_cache"]
+
+NULL_BLOCK = 0          # physical block 0: pad/dummy scratch, never allocated
+_ROOT = -1              # trie parent id of a prompt's first block
+
+
+def init_paged_kv_cache(config, num_blocks: int, block_len: int, dtype=None):
+    """Pooled paged cache: (L, 2, num_blocks, block_len, kv_heads, head_dim)
+    — the contiguous cache's (B, max_len) plane re-cut into fixed blocks."""
+    import jax.numpy as jnp
+
+    dt = dtype if dtype is not None else config.dtype
+    return jnp.zeros((config.num_hidden_layers, 2, num_blocks, block_len,
+                      config.num_key_value_heads, config.head_dim), dt)
+
+
+class _SlotAlloc:
+    __slots__ = ("chain", "reserved_left")
+
+    def __init__(self, chain: List[int], reserved_left: int):
+        self.chain = chain
+        self.reserved_left = reserved_left
+
+
+class BlockManager:
+    """Host-side allocator for a pool of ``num_blocks`` KV blocks of
+    ``block_len`` tokens (block 0 reserved as the null block).
+
+    ``stats`` counters: ``prefix_lookups`` (admissions that consulted the
+    trie), ``prefix_hit_blocks`` / ``prefix_hit_tokens`` (blocks/tokens
+    adopted instead of recomputed), ``evictions`` (cached blocks reclaimed
+    under pressure), ``cow_copies`` (ensure_writable copies), and
+    ``peak_blocks_in_use`` (high-water mark of referenced blocks).
+    """
+
+    def __init__(self, num_blocks: int, block_len: int,
+                 prefix_cache: bool = True):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the null block), "
+                f"got {num_blocks}")
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        self.num_blocks = int(num_blocks)
+        self.block_len = int(block_len)
+        self.prefix_cache = bool(prefix_cache)
+        self._free: Deque[int] = deque(range(1, num_blocks))
+        self._ref = np.zeros(num_blocks, np.int64)
+        self._reserved = 0                       # admitted-but-unallocated
+        self._slots: Dict[int, _SlotAlloc] = {}
+        # chain-keyed trie: (parent block id, this block's tokens) -> id
+        self._trie: Dict[Tuple[int, Tuple[int, ...]], int] = {}
+        self._block_key: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+        self._children: Dict[int, Set[int]] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # ref==0 cached
+        self.stats = {"prefix_lookups": 0, "prefix_hit_blocks": 0,
+                      "prefix_hit_tokens": 0, "evictions": 0,
+                      "cow_copies": 0, "peak_blocks_in_use": 0}
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        """Pool capacity a request can ever draw on (excludes the null
+        block; includes blocks currently parked on the eviction LRU)."""
+        return self.num_blocks - 1
+
+    def blocks_in_use(self) -> int:
+        """Blocks referenced by at least one live chain."""
+        return int((self._ref > 0).sum())
+
+    def cached_blocks(self) -> int:
+        """Retired prefix blocks kept for future hits (evictable)."""
+        return len(self._lru)
+
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case blocks a request needs over its whole lifetime
+        (positions 0 .. prompt_len + max_new_tokens - 1)."""
+        return -(-(prompt_len + max_new_tokens) // self.block_len)
+
+    def _available(self) -> int:
+        return len(self._free) + len(self._lru) - self._reserved
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, slot: int, prompt: Sequence[int], prompt_len: int,
+              max_new_tokens: int) -> Optional[int]:
+        """Admit a request into ``slot``: match the prompt against the
+        prefix trie, reserve every block the request could need, allocate
+        the blocks covering positions ``[0, prompt_len]`` now, and
+        register the prompt's full blocks for future sharing.
+
+        Returns the number of prefix TOKENS adopted from the cache (the
+        prefill may skip recomputing them), or ``None`` when the pool
+        cannot cover the request yet (caller keeps it queued).  The match
+        is capped at ``(prompt_len - 1) // block_len`` blocks so at least
+        one token remains to produce the first sampled logits.
+        """
+        if slot in self._slots:
+            raise ValueError(f"slot {slot} already has an allocation")
+        bl = self.block_len
+        prompt = [int(t) for t in prompt[:prompt_len]]
+        matched: List[int] = []
+        if self.prefix_cache:
+            self.stats["prefix_lookups"] += 1
+            parent = _ROOT
+            for b in range((prompt_len - 1) // bl):
+                bid = self._trie.get((parent, tuple(prompt[b * bl:
+                                                          (b + 1) * bl])))
+                if bid is None:
+                    break
+                matched.append(bid)
+                parent = bid
+        m = len(matched)
+        total = self.blocks_needed(prompt_len, max_new_tokens)
+        need = total - m
+        # a revived LRU block stops being evictable, so count the match
+        # against availability too
+        revive = sum(1 for bid in matched if self._ref[bid] == 0)
+        if self._available() - revive < need:
+            return None
+        for bid in matched:                      # adopt the shared chain
+            if self._ref[bid] == 0:
+                self._lru.pop(bid, None)
+            self._ref[bid] += 1
+        st = _SlotAlloc(list(matched), need)
+        self._slots[slot] = st
+        self._reserved += need
+        # blocks covering positions [0, prompt_len]: the prefill writes the
+        # suffix and the first decode step writes position prompt_len
+        for _ in range(prompt_len // bl + 1 - m):
+            self._append_block(st)
+        if self.prefix_cache:
+            self._register_prompt(st.chain, prompt, prompt_len)
+        self.stats["prefix_hit_blocks"] += m
+        self.stats["prefix_hit_tokens"] += m * bl
+        self._note_peak()
+        return m * bl
+
+    def _register_prompt(self, chain: List[int], prompt: List[int],
+                         prompt_len: int):
+        """Insert the prompt's FULL blocks into the trie.  Only blocks
+        whose every position is a prompt token are registered — the block
+        holding position ``prompt_len`` onward is still being written by
+        decode and must stay private."""
+        bl = self.block_len
+        parent = _ROOT
+        for b in range(prompt_len // bl):
+            bid = chain[b]
+            key = (parent, tuple(prompt[b * bl:(b + 1) * bl]))
+            if key not in self._trie and bid not in self._block_key:
+                self._trie[key] = bid
+                self._block_key[bid] = key
+                if parent != _ROOT:
+                    self._children.setdefault(parent, set()).add(bid)
+            parent = self._trie.get(key, bid)
+
+    # -- growth / writes ---------------------------------------------------
+
+    def _pop_block(self) -> int:
+        if self._free:
+            return self._free.popleft()
+        return self._evict_one()
+
+    def _append_block(self, st: _SlotAlloc) -> int:
+        if st.reserved_left <= 0:
+            raise RuntimeError(
+                "block allocation beyond the slot's admission reservation "
+                "(engine bug: reservation must cover prompt + max_new)")
+        bid = self._pop_block()
+        self._ref[bid] = 1
+        st.chain.append(bid)
+        st.reserved_left -= 1
+        self._reserved -= 1
+        return bid
+
+    def ensure_capacity(self, slot: int, pos: int) -> bool:
+        """Grow ``slot``'s chain until it covers position ``pos``.
+        Returns True when blocks were appended (table row changed)."""
+        st = self._slots[slot]
+        grew = False
+        while len(st.chain) * self.block_len <= pos:
+            self._append_block(st)
+            grew = True
+        if grew:
+            self._note_peak()
+        return grew
+
+    def ensure_writable(self, slot: int,
+                        logical_block: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write guard: make ``slot``'s ``logical_block`` private.
+        Returns ``(src, dst)`` physical ids when a copy is needed (caller
+        must copy the device block src -> dst), else None.  The fresh
+        block comes from the free/evictable pool — COW is not covered by
+        the admission reservation (it cannot occur in the append-only
+        engine flow; forking callers must size the pool for it)."""
+        st = self._slots[slot]
+        src = st.chain[logical_block]
+        if self._ref[src] <= 1:
+            return None
+        dst = self._pop_block()
+        self._ref[src] -= 1
+        self._ref[dst] = 1
+        st.chain[logical_block] = dst
+        self.stats["cow_copies"] += 1
+        self._note_peak()
+        return src, dst
+
+    # -- retirement / eviction --------------------------------------------
+
+    def release(self, slot: int):
+        """Retire a slot: drop its references and its unused reservation.
+        Trie-registered blocks that reach refcount 0 are parked on the
+        eviction LRU (future prefix hits revive them for free); anonymous
+        blocks return to the free list."""
+        st = self._slots.pop(slot)
+        self._reserved -= st.reserved_left
+        for bid in st.chain:
+            self._ref[bid] -= 1
+            if self._ref[bid] == 0:
+                if bid in self._block_key:
+                    self._lru[bid] = None
+                    self._lru.move_to_end(bid)
+                else:
+                    self._free.append(bid)
+
+    def _evict_one(self) -> int:
+        """Reclaim the LRU cached block.  Unregistering cascades through
+        the block's trie descendants (their chain keys dangle once the
+        parent id is reused): cached descendants move to the free list,
+        live ones just lose their trie entry."""
+        if not self._lru:
+            raise RuntimeError(
+                "KV block pool exhausted: no free or evictable blocks "
+                "(reservation accounting should have prevented this)")
+        bid, _ = self._lru.popitem(last=False)
+        self.stats["evictions"] += 1
+        stack = [bid]
+        while stack:
+            b = stack.pop()
+            key = self._block_key.pop(b, None)
+            if key is not None:
+                self._trie.pop(key, None)
+            stack.extend(self._children.pop(b, ()))
+            if b != bid and b in self._lru:
+                del self._lru[b]
+                self._free.append(b)
+        return bid
+
+    # -- table export ------------------------------------------------------
+
+    def table_row(self, slot: int, max_blocks: int) -> np.ndarray:
+        """(max_blocks,) int32 physical ids, null-block-filled past the
+        allocated chain (every entry is a valid pool index)."""
+        st = self._slots[slot]
+        if len(st.chain) > max_blocks:
+            raise ValueError(
+                f"slot {slot} chain ({len(st.chain)} blocks) exceeds "
+                f"max_blocks ({max_blocks})")
+        row = np.full((max_blocks,), NULL_BLOCK, np.int32)
+        row[:len(st.chain)] = st.chain
+        return row
+
+    def chain(self, slot: int) -> List[int]:
+        return list(self._slots[slot].chain)
+
+    def _note_peak(self):
+        used = self.blocks_in_use()
+        if used > self.stats["peak_blocks_in_use"]:
+            self.stats["peak_blocks_in_use"] = used

@@ -94,6 +94,100 @@ def test_shape_ineligibility_raises():
         decode_attention_pallas(q, k, v, 5, interpret=True)
 
 
+# -- paged cache: block-table dereference ------------------------------------
+
+def _paged_pool(kc, vc, tables, num_pool, bl):
+    """Scatter each row's logical blocks into the physical pool slots the
+    table names (the inverse of what the kernel/gather path computes)."""
+    b, L, hkv, d = kc.shape
+    kp = np.zeros((num_pool, bl, hkv, d), kc.dtype)
+    vp = np.zeros_like(kp)
+    for r in range(b):
+        for j in range(L // bl):
+            kp[tables[r, j]] = kc[r, j * bl:(j + 1) * bl]
+            vp[tables[r, j]] = vc[r, j * bl:(j + 1) * bl]
+    return jnp.asarray(kp), jnp.asarray(vp)
+
+
+PAGED_CASES = [
+    # (b, s, hq, hkv, d, mb, pos, tables) — bl = 128 always; tables are
+    # out-of-order, shared across rows, and positions end mid-block
+    (2, 1, 8, 2, 64, 3, [130, 77],
+     [[5, 3, 1], [5, 6, 2]]),                  # shared block 5, OOO ids
+    (2, 3, 8, 2, 64, 3, [130, 77],
+     [[5, 3, 1], [5, 6, 2]]),                  # s>1 prefill-into-slot
+    (1, 1, 4, 4, 32, 2, [255], [[7, 2]]),      # g=1, last slot live
+    (3, 2, 8, 4, 64, 4, [40, 300, 511],
+     [[9, 9, 9, 9], [1, 2, 3, 4], [4, 3, 2, 1]]),  # row 0 never leaves b9
+]
+
+
+@pytest.mark.parametrize("b,s,hq,hkv,d,mb,pos,tables", PAGED_CASES)
+def test_paged_kernel_matches_contiguous_reference(b, s, hq, hkv, d, mb,
+                                                   pos, tables):
+    bl = 128
+    L = mb * bl
+    rng = np.random.default_rng(b * 10 + mb)
+    kc = rng.normal(size=(b, L, hkv, d)).astype(np.float32)
+    vc = rng.normal(size=(b, L, hkv, d)).astype(np.float32)
+    q = jnp.asarray(rng.normal(size=(b, s, hq, d)), jnp.float32)
+    tables = np.asarray(tables, np.int32)
+    # every (row, logical block) mapping to one physical block must agree
+    # on its content: the first mapping owns it, later ones copy it —
+    # covers cross-row sharing AND a row whose dead tail repeats a block
+    owner = {}
+    for r in range(b):
+        for j in range(mb):
+            key = int(tables[r, j])
+            if key in owner:
+                ro, jo = owner[key]
+                kc[r, j * bl:(j + 1) * bl] = kc[ro, jo * bl:(jo + 1) * bl]
+                vc[r, j * bl:(j + 1) * bl] = vc[ro, jo * bl:(jo + 1) * bl]
+            else:
+                owner[key] = (r, j)
+    pos = jnp.asarray(pos, jnp.int32)
+    want = cached_decode_attention_reference(q, jnp.asarray(kc),
+                                             jnp.asarray(vc), pos)
+    kp, vp = _paged_pool(kc, vc, tables, num_pool=10, bl=bl)
+    got = decode_attention_pallas(q, kp, vp, pos,
+                                  block_tables=jnp.asarray(tables),
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # the XLA gather path is the same oracle through the table
+    got_ref = cached_decode_attention_reference(
+        q, kp, vp, pos, block_tables=jnp.asarray(tables))
+    np.testing.assert_allclose(np.asarray(got_ref), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_rejects_unaligned_block_len():
+    rng = np.random.default_rng(0)
+    kp = jnp.asarray(rng.normal(size=(4, 64, 2, 32)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(1, 1, 4, 32)), jnp.float32)
+    with pytest.raises(NotImplementedError, match="128-aligned"):
+        decode_attention_pallas(q, kp, kp, 5,
+                                block_tables=jnp.asarray([[1, 2]]),
+                                interpret=True)
+
+
+def test_paged_live_len_trims_table_columns():
+    bl, mb = 128, 4
+    rng = np.random.default_rng(3)
+    kc = rng.normal(size=(2, mb * bl, 2, 64)).astype(np.float32)
+    vc = rng.normal(size=(2, mb * bl, 2, 64)).astype(np.float32)
+    q = jnp.asarray(rng.normal(size=(2, 1, 8, 64)), jnp.float32)
+    tables = np.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+    kp, vp = _paged_pool(kc, vc, tables, num_pool=9, bl=bl)
+    pos = jnp.asarray([100, 200], jnp.int32)
+    full = cached_decode_attention_reference(
+        q, kp, vp, pos, block_tables=jnp.asarray(tables))
+    trimmed = cached_decode_attention_reference(
+        q, kp, vp, pos, block_tables=jnp.asarray(tables), live_len=256)
+    np.testing.assert_allclose(np.asarray(trimmed), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
+
+
 # -- cached_decode_attention dispatch contract -------------------------------
 
 class TestDispatch:
@@ -165,6 +259,100 @@ class TestDispatch:
         got = jax.jit(cached_decode_attention)(q, k, v, pos)
         want = cached_decode_attention_reference(q, k, v, pos)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_paged_routes_to_kernel_and_matches(self, monkeypatch):
+        from paddle_tpu.ops.pallas import decode_attention as mod
+
+        calls = []
+        real = mod.decode_attention_pallas
+        monkeypatch.setattr(
+            mod, "decode_attention_pallas",
+            lambda *a, **kw: calls.append(1) or real(*a, **kw))
+        bl, mb = 128, 2
+        rng = np.random.default_rng(41)
+        kc = rng.normal(size=(2, mb * bl, 2, 64)).astype(np.float32)
+        vc = rng.normal(size=(2, mb * bl, 2, 64)).astype(np.float32)
+        q = jnp.asarray(rng.normal(size=(2, 1, 8, 64)), jnp.float32)
+        tables = np.asarray([[4, 2], [3, 1]], np.int32)
+        kp, vp = _paged_pool(kc, vc, tables, num_pool=5, bl=bl)
+        pos = jnp.asarray([130, 77], jnp.int32)
+        got = cached_decode_attention(q, kp, vp, pos,
+                                      block_tables=jnp.asarray(tables))
+        assert calls, "eligible paged shape did not route to the kernel"
+        want = cached_decode_attention_reference(q, jnp.asarray(kc),
+                                                 jnp.asarray(vc), pos)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # routing decision is exposed to the bench
+        assert decode_attention_path(2, 1, 8, 2, 64, mb * bl,
+                                     paged_block_len=bl)[0] \
+            == "pallas_decode"
+
+    def test_paged_unaligned_block_len_takes_gather_path(self, monkeypatch):
+        from paddle_tpu.ops.pallas import decode_attention as mod
+
+        calls = []
+        monkeypatch.setattr(mod, "decode_attention_pallas",
+                            lambda *a, **kw: calls.append(1))
+        bl, mb = 64, 4                         # 64 % 128 != 0
+        rng = np.random.default_rng(43)
+        kc = rng.normal(size=(1, mb * bl, 2, 64)).astype(np.float32)
+        vc = rng.normal(size=(1, mb * bl, 2, 64)).astype(np.float32)
+        q = jnp.asarray(rng.normal(size=(1, 1, 8, 64)), jnp.float32)
+        tables = np.asarray([[4, 3, 2, 1]], np.int32)
+        kp, vp = _paged_pool(kc, vc, tables, num_pool=5, bl=bl)
+        got = cached_decode_attention(q, kp, vp, 100,
+                                      block_tables=jnp.asarray(tables))
+        assert not calls
+        want = cached_decode_attention_reference(q, jnp.asarray(kc),
+                                                 jnp.asarray(vc), 100)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        assert decode_attention_path(1, 1, 8, 2, 64, mb * bl,
+                                     paged_block_len=bl)[0] == "xla_math"
+
+    def test_llama_paged_decode_step_through_kernel(self):
+        """Model-level paged integration: a llama decode_step over the
+        block pool (shuffled physical blocks) must reproduce the
+        contiguous decode_step's logits, with the incremental attention
+        running the flash-decode kernel."""
+        import paddle_tpu as pt
+        from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+        from paddle_tpu.models.generation import init_kv_cache
+        from paddle_tpu.serving.kv_cache import init_paged_kv_cache
+
+        pt.seed(5)
+        lm = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+        lm.eval()
+        ids = jnp.asarray(np.random.default_rng(6).integers(
+            0, 256, (2, 7)), jnp.int32)
+        cache = init_kv_cache(lm.config, 2, 128)
+        _, cache = lm.decode_step(ids, cache, 0)
+        positions = jnp.asarray([7, 5], jnp.int32)
+        tok = jnp.asarray([[3], [9]], jnp.int32)
+        logits_c, cache_c = lm.decode_step(tok, cache, positions)
+        # pool the contiguous rows into shuffled physical blocks (one
+        # 128-token block per row at this max_length)
+        tables = np.asarray([[3], [1]], np.int32)
+        pool = init_paged_kv_cache(lm.config, 5, 128)
+        pool = pool.at[:, :, 3].set(cache[:, :, 0])
+        pool = pool.at[:, :, 1].set(cache[:, :, 1])
+        flags.set_flags({"decode_attention_min_len": 128})
+        try:
+            logits_p, pool = lm.decode_step(
+                tok, pool, positions, block_tables=jnp.asarray(tables))
+        finally:
+            flags.set_flags({"decode_attention_min_len": 256})
+        np.testing.assert_allclose(np.asarray(logits_p),
+                                   np.asarray(logits_c),
+                                   rtol=2e-4, atol=2e-4)
+        # the paged write landed in each row's physical block
+        np.testing.assert_allclose(np.asarray(pool[:, :, 3]),
+                                   np.asarray(cache_c[:, :, 0]),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(pool[:, :, 1]),
+                                   np.asarray(cache_c[:, :, 1]),
                                    rtol=2e-5, atol=2e-5)
 
     def test_llama_decode_step_through_kernel(self):

@@ -1,0 +1,147 @@
+"""Paged KV cache block manager (paddle_tpu/serving/kv_cache.py) — pure
+host-side unit tests: allocation/reservation accounting, prefix-trie
+matching (full-block granularity, last-token cap), refcounted sharing,
+LRU eviction with cascading trie invalidation, and copy-on-write.  The
+device-side block-table consumers are covered by
+tests/test_decode_attention_pallas.py (kernel + XLA gather) and
+tests/test_serving_paged.py (engine parity)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.kv_cache import NULL_BLOCK, BlockManager
+
+
+def _mgr(num_blocks=9, block_len=4, prefix_cache=True):
+    return BlockManager(num_blocks, block_len, prefix_cache=prefix_cache)
+
+
+def _toks(n, seed=0, lo=0, hi=100):
+    return list(np.random.RandomState(seed).randint(lo, hi, n))
+
+
+def test_null_block_reserved_and_basic_alloc():
+    m = _mgr()
+    assert m.usable_blocks == 8
+    p = _toks(6, 1)
+    got = m.admit(0, p, 6, 4)             # needs ceil(10/4) = 3 blocks
+    assert got == 0                        # empty trie: no prefix adopted
+    chain = m.chain(0)
+    # blocks covering positions [0, 6]: 6//4 + 1 = 2 allocated now
+    assert len(chain) == 2
+    assert NULL_BLOCK not in chain
+    assert m.blocks_in_use() == 2
+    row = m.table_row(0, 8)
+    assert list(row[:2]) == chain and (row[2:] == NULL_BLOCK).all()
+
+
+def test_lazy_growth_consumes_reservation():
+    m = _mgr()
+    m.admit(0, _toks(6, 1), 6, 4)
+    assert m.ensure_capacity(0, 6) is False      # position 6 covered
+    assert m.ensure_capacity(0, 8) is True       # crosses into block 2
+    assert len(m.chain(0)) == 3
+    # reservation exhausted: position 12 would need a 4th block
+    with pytest.raises(RuntimeError, match="reservation"):
+        m.ensure_capacity(0, 12)
+
+
+def test_admission_denied_until_blocks_free():
+    m = _mgr(num_blocks=5, block_len=4)          # 4 usable blocks
+    assert m.admit(0, _toks(6, 1), 6, 6) == 0    # reserves ceil(12/4) = 3
+    assert m.admit(1, _toks(6, 2), 6, 6) is None  # 1 available < 3 needed
+    m.release(0)
+    assert m.admit(1, _toks(6, 2), 6, 6) == 0
+
+
+def test_prefix_match_caps_at_last_token():
+    m = _mgr(num_blocks=17, block_len=4)
+    sys_p = _toks(8, 3)                          # exactly 2 full blocks
+    m.admit(0, sys_p, 8, 4)
+    # identical prompt: both full blocks are registered, but the match
+    # must stop at (plen-1)//bl = 1 so one real token remains
+    got = m.admit(1, sys_p, 8, 4)
+    assert got == 4
+    assert m.chain(1)[0] == m.chain(0)[0]        # shared physical block
+    assert m.chain(1)[1] != m.chain(0)[1]
+    # longer prompt sharing the 8-token prefix adopts BOTH blocks
+    p2 = sys_p + _toks(5, 4)
+    got = m.admit(2, p2, 13, 4)
+    assert got == 8
+    assert m.chain(2)[:2] == m.chain(0)[:2]
+    assert m.stats["prefix_hit_tokens"] == 12
+    assert m.stats["prefix_hit_blocks"] == 3
+
+
+def test_partial_tail_block_never_registered():
+    m = _mgr(block_len=4)
+    p = _toks(6, 5)                              # block 1 only half full
+    m.admit(0, p, 6, 4)
+    m.release(0)
+    # only the FULL block (tokens 0..3) is cacheable; a new request with
+    # the same 6-token prompt matches one block, not two
+    assert m.admit(1, p, 6, 4) == 4
+
+
+def test_release_parks_trie_blocks_for_revival():
+    m = _mgr(block_len=4)
+    p = _toks(8, 6)
+    m.admit(0, p, 8, 4)
+    m.release(0)
+    assert m.blocks_in_use() == 0
+    assert m.cached_blocks() == 2                # both full blocks kept
+    got = m.admit(1, p, 8, 4)                    # revived, not recomputed
+    assert got == 4
+    assert m.stats["evictions"] == 0
+
+
+def test_eviction_under_pressure_and_cascade():
+    m = _mgr(num_blocks=5, block_len=4)          # 4 usable
+    p = _toks(8, 7)
+    m.admit(0, p, 8, 8)                          # 4 blocks reserved
+    m.release(0)                                 # 2 cached + 2 free
+    # an unrelated request needing all 4 usable blocks forces eviction
+    q = _toks(12, 8, lo=200, hi=300)
+    assert m.admit(1, q, 12, 4) == 0
+    assert m.stats["evictions"] >= 1
+    m.release(1)
+    # the evicted chain must NOT match any more: its parent id was
+    # reclaimed, so a stale child entry would be a wrong-content hit
+    assert m.admit(2, p, 8, 4) == 0
+
+
+def test_cow_on_shared_block():
+    m = _mgr(block_len=4)
+    p = _toks(8, 9)
+    m.admit(0, p, 8, 4)
+    m.admit(1, p + _toks(2, 10), 10, 4)          # shares both full blocks
+    shared = m.chain(0)[0]
+    assert m.chain(1)[0] == shared
+    cow = m.ensure_writable(1, 0)
+    assert cow is not None and cow[0] == shared
+    assert m.chain(1)[0] == cow[1] != shared
+    assert m.chain(0)[0] == shared               # owner untouched
+    assert m.stats["cow_copies"] == 1
+    # private block: no copy
+    assert m.ensure_writable(1, 0) is None
+
+
+def test_prefix_cache_disabled_frees_immediately():
+    m = _mgr(prefix_cache=False)
+    p = _toks(8, 11)
+    m.admit(0, p, 8, 4)
+    m.release(0)
+    assert m.cached_blocks() == 0
+    assert m.admit(1, p, 8, 4) == 0              # nothing to match
+    assert m.stats["prefix_lookups"] == 0
+
+
+def test_peak_counter_and_needed():
+    m = _mgr(num_blocks=17, block_len=4)
+    assert m.blocks_needed(6, 4) == 3
+    m.admit(0, _toks(6, 12), 6, 4)
+    m.admit(1, _toks(6, 13, lo=100, hi=200), 6, 4)
+    assert m.stats["peak_blocks_in_use"] == 4
+    m.release(0)
+    m.release(1)
+    assert m.stats["peak_blocks_in_use"] == 4
