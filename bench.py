@@ -818,9 +818,15 @@ def _serving_bench(model, on_tpu):
            "mean_slot_occupancy": round(float(np.mean(occ)) / slots, 3),
            "step_traces": eng.step_traces,
            "prefill_traces": eng.prefill_traces,
+           # SLO snapshot straight from the observability registry (the
+           # engine's own series; BASELINE.md conventions) — TTFT/TPOT/
+           # queue-wait percentiles span BOTH passes, so the warm pass's
+           # compile stalls sit in the tail, not the median
+           "metrics": eng.metrics(),
            "note": "second pass through a warm engine; occupancy is "
                    "busy slots / num_slots averaged over ticks "
-                   "(idle arrival gaps included)"}
+                   "(idle arrival gaps included); metrics histograms "
+                   "span both passes"}
     out["paged"] = _paged_serving_bench(model, on_tpu)
     return out
 
@@ -897,6 +903,11 @@ def _paged_serving_bench(model, on_tpu):
             "prefill_tokens_computed_2pass": eng.prefill_tokens_computed,
             "step_traces": eng.step_traces,
             "prefill_traces": eng.prefill_traces,
+            # registry snapshot: percentiles + the pool's cache
+            # accounting (metrics.kv_cache.prefix_hit_rate uses admitted
+            # prompt tokens as denominator, so it matches the
+            # prefix_hit_rate field above by construction)
+            "metrics": eng.metrics(),
             "note": "same warm-engine two-pass protocol as the "
                     "contiguous row; hit counters span BOTH passes "
                     "(hit_rate denominator = 2x trace prompt tokens)"}

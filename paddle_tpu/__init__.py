@@ -41,7 +41,8 @@ if not hasattr(_jax.lax, "axis_size"):
     _jax.lax.axis_size = _axis_size
 
 from . import (amp, distributed, flags, framework, hapi, inference, io,
-               jit, metric, nn, optimizer, profiler, static, tensor, utils)
+               jit, metric, nn, observability, optimizer, profiler, static,
+               tensor, utils)
 from .framework import (device_count, get_default_dtype, is_compiled_with_tpu,
                         load, save, seed, set_default_dtype, to_tensor)
 from .flags import get_flags, set_flags
@@ -55,8 +56,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "amp", "distributed", "flags", "framework", "hapi", "inference", "io",
-    "jit", "metric", "nn", "optimizer", "profiler", "static", "tensor",
-    "utils",
+    "jit", "metric", "nn", "observability", "optimizer", "profiler",
+    "static", "tensor", "utils",
     "Model", "summary",
     "seed", "to_tensor", "device_count", "is_compiled_with_tpu",
     "get_default_dtype", "set_default_dtype", "get_flags", "set_flags",

@@ -164,3 +164,18 @@ DEFINE("kv_cache_num_blocks", 0,
 DEFINE("serving_prefix_cache", True,
        "register full prompt blocks in the paged cache's prefix trie and "
        "serve later prompts that share them without recompute")
+# observability (paddle_tpu/observability): metrics registry + span tracer
+DEFINE("retrace_watchdog", "warn",
+       "action when a track_retraces call-site compiles past its trace "
+       "budget: 'raise' (RetraceError inside the offending trace — the "
+       "tier-1 conftest arms this for every test), 'warn' (one "
+       "RetraceWarning per violation), 'off' (count only).  The count "
+       "always lands in the jit.traces registry counter")
+DEFINE("observability_spans", True,
+       "record host spans (serving tick/prefill/decode, RecordEvent "
+       "scopes) into the default SpanTracer for Chrome-trace/Perfetto "
+       "export; off leaves span() calls as no-ops")
+DEFINE("trace_buffer_events", 100000,
+       "span-tracer ring-buffer capacity: a long-running server keeps "
+       "the most recent window of host spans and counts the rest as "
+       "dropped (SpanTracer.dropped)")

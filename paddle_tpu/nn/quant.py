@@ -120,9 +120,11 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
                 y = int8_matmul_pallas(
                     x, w, weight_scale,
                     interpret=_dispatch.pallas_interpret())
+                _dispatch.count_kernel_path("int8_matmul", "pallas_int8")
                 return y if bias is None else y + bias
             except NotImplementedError:
                 pass                       # shape-ineligible → XLA path
+        _dispatch.count_kernel_path("int8_matmul", "xla_dequant")
     if weight_dtype == "int4":
         w = _unpack_int4(w, x.shape[-1])
     compute = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) \

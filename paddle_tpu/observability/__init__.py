@@ -1,0 +1,49 @@
+"""paddle_tpu.observability — unified metrics + tracing layer.
+
+The telemetry the serving north star ("heavy traffic ... as fast as the
+hardware allows") requires as a *layer*, not per-module counters:
+
+  * :mod:`.metrics` — a thread-safe registry of counters / gauges /
+    fixed-bucket histograms with percentile readout, exported as a JSON
+    snapshot (bench artifacts, tests) or Prometheus text exposition (a
+    serving host's scrape endpoint).  ServingEngine (TTFT / TPOT /
+    queue-wait / occupancy), BlockManager (pool occupancy, prefix hits,
+    evictions, COW) and the ops dispatchers (kernel-path selections)
+    all report here — ``observability.snapshot()`` after a serving
+    trace is the whole story in one dict;
+  * :mod:`.tracing` — a host-side span tracer with Chrome-trace /
+    Perfetto JSON export, composed with ``profiler.RecordEvent`` so the
+    same labelled regions appear against XLA device traces;
+  * :mod:`.watchdog` — ``track_retraces``: per-call-site jit trace
+    counting with a budget, generalising the engine's
+    ``step_traces == 1`` contract into a reusable, CI-armed guarantee.
+
+Conventions: metric names are dotted lowercase (``serving.ttft_ms``);
+millisecond histograms carry the ``_ms`` suffix; per-instance series are
+distinguished by labels (``engine="0"``, ``pool="1"``), never by name.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS_MS,
+                      MetricsRegistry, default_registry, prometheus_text,
+                      snapshot)
+from .metrics import reset as _reset_metrics
+from .tracing import (SpanTracer, export_chrome_trace, get_tracer, instant,
+                      span)
+from .watchdog import (RetraceError, RetraceWarning, TrackedFunction,
+                       track_retraces)
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "LATENCY_BUCKETS_MS", "default_registry", "snapshot",
+    "prometheus_text", "reset",
+    "SpanTracer", "get_tracer", "span", "instant", "export_chrome_trace",
+    "RetraceError", "RetraceWarning", "TrackedFunction", "track_retraces",
+]
+
+
+def reset() -> None:
+    """Clear the default registry AND the default tracer's buffer (test
+    isolation; live metric handles keep working but stop being exported
+    until re-registered)."""
+    _reset_metrics()
+    get_tracer().clear()

@@ -1,0 +1,183 @@
+"""Host-side span tracer with Chrome-trace / Perfetto JSON export.
+
+Dapper-style request tracing for the serving pipeline: nestable spans
+opened on the host (scheduler tick, admission wave, the jitted decode
+dispatch) recorded as complete events — ``{"ph": "X", "ts", "dur",
+"pid", "tid", ...}`` microseconds — in the Trace Event format both
+chrome://tracing and https://ui.perfetto.dev load directly.  Nesting
+needs no parent pointers: Perfetto stacks events on one tid by ts/dur
+containment, which the context-manager discipline guarantees.
+
+Composition with device traces: :class:`paddle_tpu.profiler.RecordEvent`
+emits BOTH a ``jax.profiler.TraceAnnotation`` (so the span shows up
+inside the XLA/XPlane device dump) and a host span here — the same
+labelled region appears in the device timeline and in this exporter's
+host timeline, which is what lets queue-wait and dispatch gaps be read
+against kernel activity.
+
+Cost discipline: recording one span is two ``perf_counter_ns`` calls and
+one deque append under a lock — O(1) host work, no device syncs.  The
+buffer is a ring (``FLAGS_trace_buffer_events``): a long-running server
+keeps the most recent window and counts what it dropped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from collections import deque
+
+__all__ = ["SpanTracer", "get_tracer", "span", "instant",
+           "export_chrome_trace"]
+
+
+class _OpenSpan:
+    __slots__ = ("name", "cat", "args", "ts", "tid")
+
+    def __init__(self, name: str, cat: str, args: Dict[str, Any],
+                 ts: float, tid: int):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.ts = ts
+        self.tid = tid
+
+
+class SpanTracer:
+    """Collects host spans into a bounded ring buffer.
+
+    ``span(name, **args)`` is the context-manager form; ``start`` /
+    ``finish`` are the split form for callers with begin/end APIs
+    (profiler.RecordEvent).  ``enabled=False`` turns both into no-ops.
+    """
+
+    def __init__(self, max_events: Optional[int] = None,
+                 enabled: Optional[bool] = None):
+        from .. import flags as _flags
+        if max_events is None:
+            max_events = int(_flags.flag("trace_buffer_events"))
+        if enabled is None:
+            enabled = bool(_flags.flag("observability_spans"))
+        self.enabled = enabled
+        self.max_events = max(1, int(max_events))
+        self.dropped = 0
+        self._events: "deque[Dict[str, Any]]" = deque()
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        # one wall-clock origin per tracer so every span shares a timebase
+        self._t0 = time.perf_counter_ns()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    # -- recording ---------------------------------------------------------
+
+    def start(self, name: str, cat: str = "host",
+              **args: Any) -> Optional[_OpenSpan]:
+        if not self.enabled:
+            return None
+        return _OpenSpan(name, cat, args, self._now_us(),
+                         threading.get_ident())
+
+    def finish(self, span: Optional[_OpenSpan]) -> None:
+        if span is None or not self.enabled:
+            return
+        ev = {"name": span.name, "cat": span.cat, "ph": "X",
+              "ts": span.ts, "dur": self._now_us() - span.ts,
+              "pid": self._pid, "tid": span.tid}
+        if span.args:
+            ev["args"] = span.args
+        self._append(ev)
+
+    @contextmanager
+    def span(self, name: str, cat: str = "host", **args: Any):
+        s = self.start(name, cat, **args)
+        try:
+            yield s
+        finally:
+            self.finish(s)
+
+    def instant(self, name: str, cat: str = "host", **args: Any) -> None:
+        """Zero-duration marker (eviction, admission rejection, ...)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": self._now_us(), "pid": self._pid,
+              "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self._events.popleft()
+                self.dropped += 1
+            self._events.append(ev)
+
+    # -- readout -----------------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def export_chrome_trace(self, path: Optional[str] = None
+                            ) -> Dict[str, Any]:
+        """Trace Event JSON (object form).  Loads in Perfetto /
+        chrome://tracing as-is; ``path`` additionally writes the file."""
+        events = self.events()
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "tid": 0, "args": {"name": "paddle_tpu host"}}]
+        for tid in sorted({e["tid"] for e in events}):
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self._pid, "tid": tid,
+                         "args": {"name": f"host-thread-{tid}"}})
+        trace = {"traceEvents": meta + events,
+                 "displayTimeUnit": "ms",
+                 "otherData": {"producer": "paddle_tpu.observability",
+                               "dropped_events": self.dropped}}
+        if path is not None:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(trace, f)
+        return trace
+
+
+# -- module-level default tracer --------------------------------------------
+
+_tracer: Optional[SpanTracer] = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> SpanTracer:
+    """The process-wide tracer every subsystem records into (created
+    lazily so FLAGS_* read their environment overrides first)."""
+    global _tracer
+    if _tracer is None:
+        with _tracer_lock:
+            if _tracer is None:
+                _tracer = SpanTracer()
+    return _tracer
+
+
+def span(name: str, cat: str = "host", **args: Any):
+    return get_tracer().span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "host", **args: Any) -> None:
+    get_tracer().instant(name, cat, **args)
+
+
+def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
+    return get_tracer().export_chrome_trace(path)

@@ -1,0 +1,125 @@
+"""Retrace watchdog: per-call-site jit trace counting with a budget.
+
+The serving engine's whole design rests on "the step function compiles
+exactly once"; PR 1 proved it with a hand-written counter
+(``ServingEngine.step_traces``) incremented by a Python side effect
+inside the traced body — side effects fire at TRACE time only, so the
+count is compilations, not calls.  :func:`track_retraces` generalises
+that trick into a reusable guarantee: wrap any function before jitting
+and every compilation increments the shared-registry counter
+``jit.traces{site=<name>}``; give it a ``budget`` and blowing past it
+warns or raises (``FLAGS_retrace_watchdog``) at the moment the offending
+trace happens — with the argument shapes/dtypes that caused it in the
+message, which is exactly the information a retrace regression needs.
+
+The tier-1 conftest arms the watchdog (``raise``) for every test, so a
+future change that makes the once-jitted serving step shape-polymorphic
+fails loudly in CI instead of silently recompiling per request.
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["RetraceError", "RetraceWarning", "TrackedFunction",
+           "track_retraces"]
+
+
+class RetraceError(RuntimeError):
+    """A tracked call-site compiled more often than its budget allows."""
+
+
+class RetraceWarning(UserWarning):
+    pass
+
+
+def _describe_args(args, kwargs) -> str:
+    def one(a):
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None:
+            return f"{dtype}{tuple(shape)}"
+        return type(a).__name__
+    parts = [one(a) for a in args]
+    parts += [f"{k}={one(v)}" for k, v in kwargs.items()]
+    return ", ".join(parts)
+
+
+class TrackedFunction:
+    """Callable wrapper returned by :func:`track_retraces`.
+
+    ``fn(...)`` dispatches to the (jitted) wrapped function; ``.traces``
+    reads the registry counter — the number of times jax traced the
+    wrapped body since this site's counter was created.
+    """
+
+    def __init__(self, fn: Callable, name: str, counter):
+        self._fn = fn
+        self.name = name
+        self.counter = counter
+        functools.update_wrapper(self, fn, updated=())
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    @property
+    def traces(self) -> int:
+        return int(self.counter.value())
+
+
+def track_retraces(fn: Callable, name: str, budget: Optional[int] = None,
+                   labels: Optional[Dict[str, Any]] = None,
+                   registry: Optional[_metrics.MetricsRegistry] = None,
+                   jit: bool = True, **jit_kwargs) -> TrackedFunction:
+    """Wrap ``fn`` so every jit trace of it is counted (and budgeted).
+
+    ``fn`` must be the PYTHON function — the counting hook runs as a
+    trace-time side effect inside the traced body, so it must be wrapped
+    *before* ``jax.jit`` (``jit=True``, the default, applies the jit
+    here; pass ``jit=False`` to count traces of a function something
+    else will jit, e.g. a ``shard_map`` body).
+
+    ``budget``: max allowed compilations for this site (``1`` = "traces
+    once, never retraces").  Exceeding it consults
+    ``FLAGS_retrace_watchdog`` at violation time: ``raise`` →
+    :class:`RetraceError` (inside the offending trace, so the bad call
+    never runs), ``warn`` → :class:`RetraceWarning`, ``off`` → count
+    only.  ``labels`` extend the counter's label set (the serving engine
+    adds ``engine=<id>`` so parallel engines budget independently).
+    """
+    reg = registry if registry is not None else _metrics.default_registry()
+    counter = reg.counter(
+        "jit.traces",
+        "jit compilations per tracked call-site (trace-time side effect; "
+        "value N means N compiled programs, not N calls)",
+    ).labels(site=name, **(labels or {}))
+
+    @functools.wraps(fn)
+    def counted(*args, **kwargs):
+        n = counter.inc()
+        if budget is not None and n > budget:
+            from .. import flags as _flags
+            action = str(_flags.flag("retrace_watchdog"))
+            if action != "off":
+                msg = (f"{name}: trace #{int(n)} exceeds the retrace "
+                       f"budget of {budget} — the call signature that "
+                       f"retraced: ({_describe_args(args, kwargs)}).  A "
+                       f"shape/dtype/static-arg varied across calls at a "
+                       f"site meant to compile {budget} time(s).")
+                if action == "raise":
+                    raise RetraceError(msg)
+                warnings.warn(msg, RetraceWarning, stacklevel=2)
+        return fn(*args, **kwargs)
+
+    if jit:
+        import jax
+        wrapped: Callable = jax.jit(counted, **jit_kwargs)
+    else:
+        if jit_kwargs:
+            raise TypeError("jit_kwargs given but jit=False")
+        wrapped = counted
+    return TrackedFunction(wrapped, name, counter)

@@ -28,3 +28,21 @@ def use_pallas() -> bool:
 def pallas_interpret() -> bool:
     return bool(flags.flag("pallas_interpret")) or default_backend() not in (
         "tpu", "axon")
+
+
+def count_kernel_path(op: str, path: str, **labels) -> None:
+    """Count one kernel-routing decision in the shared metrics registry
+    (``ops.kernel_path{op=...,path=...}``).
+
+    Dispatch decisions run at TRACE time, so the counter reads as
+    "compiled programs that chose this path", not calls — zero per-step
+    cost, and a routing regression (a serving shape silently sliding off
+    its Pallas kernel onto the XLA fallback) shows up as a counter
+    moving in ``observability.snapshot()`` instead of only as a perf
+    mystery.  Extra ``labels`` refine the series (``cache="paged"``).
+    """
+    from .. import observability
+    observability.default_registry().counter(
+        "ops.kernel_path",
+        "kernel-path selections per op, counted at dispatch/trace time",
+    ).labels(op=op, path=path, **labels).inc()

@@ -120,6 +120,12 @@ def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
     bench.py can record the chosen path per row: returns
     ``("pallas_decode", None)`` or ``("xla_math", reason)``.
 
+    Every decision is also counted into the shared metrics registry
+    (``ops.kernel_path{op="decode_attention", path=..., cache=...}``) —
+    dispatch runs at trace time, so the counters say which paths the
+    compiled programs actually took and a routing regression is visible
+    in ``observability.snapshot()``.
+
     ``paged_block_len``: set when the cache is the paged block pool
     (serving/kv_cache.py) — the kernel then pins its KV chunk to one
     block, so the block length must be 128-aligned; ``kv_len`` is the
@@ -132,6 +138,17 @@ def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
     streams the dead cache tail; that regime goes to the Pallas
     flash-decode kernel (FLAGS_decode_attention_min_len, default 4096).
     """
+    path, reason = _decode_attention_decision(b, s, hq, hkv, d, kv_len,
+                                              has_extra_mask,
+                                              paged_block_len)
+    _dispatch.count_kernel_path(
+        "decode_attention", path,
+        cache="paged" if paged_block_len is not None else "contiguous")
+    return path, reason
+
+
+def _decode_attention_decision(b, s, hq, hkv, d, kv_len, has_extra_mask,
+                               paged_block_len):
     from .. import flags as _flags
     if not _dispatch.use_pallas():
         return "xla_math", (f"no Pallas-capable backend "
@@ -382,10 +399,12 @@ def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                     interpret=_dispatch.pallas_interpret(),
                     segment_ids=segment_ids,
                     kv_segment_ids=kv_segment_ids)
+                _dispatch.count_kernel_path("flash_attention", "pallas")
                 return (out, lse) if return_lse else out
             except NotImplementedError as e:
                 reason = str(e)
         _fallback(reason)
+    _dispatch.count_kernel_path("flash_attention", "xla_reference")
     if segment_ids is not None:
         seg = segment_mask(segment_ids,
                            segment_ids if kv_segment_ids is None

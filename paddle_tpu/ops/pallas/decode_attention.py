@@ -227,6 +227,14 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
     if rows_p != rows:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_p - rows), (0, 0)))
 
+    # past every eligibility gate: this trace builds the kernel — count
+    # which cache layout it was built for (routing visibility, trace-time
+    # side effect only)
+    from .. import _dispatch as _disp
+    _disp.count_kernel_path(
+        "decode_attention_kernel",
+        "paged" if block_tables is not None else "contiguous")
+
     kernel = functools.partial(
         _kernel, scale=float(scale), s=s, g=g, hkv=hkv, d=d, rows=rows,
         rows_p=rows_p, bk=bk, chunks=chunks)

@@ -78,17 +78,29 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
 
 class RecordEvent:
     """User-scope annotation visible in the trace (parity:
-    paddle.profiler.RecordEvent; ≙ jax.profiler.TraceAnnotation)."""
+    paddle.profiler.RecordEvent; ≙ jax.profiler.TraceAnnotation).
+
+    Emits the scope TWICE so host and device views line up: as a jax
+    TraceAnnotation (shows up inside the XLA/XPlane device dump) and as
+    a host span in ``paddle_tpu.observability``'s tracer (shows up in
+    the Chrome-trace/Perfetto export next to the serving scheduler's
+    spans) — the same labelled region in both timelines."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        self._span = None
 
     def begin(self):
+        from .. import observability
+        self._span = observability.get_tracer().start(self.name, cat="user")
         self._ann.__enter__()
 
     def end(self):
+        from .. import observability
         self._ann.__exit__(None, None, None)
+        observability.get_tracer().finish(self._span)
+        self._span = None
 
     def __enter__(self):
         self.begin()
@@ -126,24 +138,47 @@ class Profiler:
         self.step_num = 0
         self.current_state = ProfilerState.CLOSED
         self._tracing = False
+        self._in_export = False
         self._step_times = []
         self._last_t: Optional[float] = None
         self._last_export: Optional[str] = None
 
     # -- state machine -------------------------------------------------------
 
+    def _finish_trace(self):
+        """Close the current trace segment and fire on_trace_ready
+        EXACTLY once for it.  ``_tracing`` is cleared before anything
+        else runs, so the method is idempotent per segment however
+        ``stop()`` and scheduler transitions interleave (the historical
+        double-export: ``stop()`` right after a RECORD_AND_RETURN
+        transition re-ran the export path), and ``_in_export`` guards a
+        handler that itself calls ``stop()`` from recursing back in."""
+        if not self._tracing:
+            return
+        self._tracing = False
+        jax.profiler.stop_trace()
+        if self.on_trace_ready is not None and not self._in_export:
+            self._in_export = True
+            try:
+                self.on_trace_ready(self)
+            finally:
+                self._in_export = False
+
     def _transition(self):
         new = self.scheduler(self.step_num)
         recording = new in (ProfilerState.RECORD,
                             ProfilerState.RECORD_AND_RETURN)
+        # RECORD_AND_RETURN means "last record step of a cycle": leaving
+        # it is a segment boundary even when the next state records again
+        # (repeat cycles) — previously back-to-back cycles merged into
+        # one ever-growing trace and only exported once at the very end
+        if self.current_state is ProfilerState.RECORD_AND_RETURN:
+            self._finish_trace()
         if recording and not self._tracing and not self.timer_only:
             jax.profiler.start_trace(self.log_dir)
             self._tracing = True
-        if not recording and self._tracing:
-            jax.profiler.stop_trace()
-            self._tracing = False
-            if self.on_trace_ready is not None:
-                self.on_trace_ready(self)
+        if not recording:
+            self._finish_trace()
         self.current_state = new
 
     def start(self):
@@ -152,11 +187,7 @@ class Profiler:
         return self
 
     def stop(self):
-        if self._tracing:
-            jax.profiler.stop_trace()
-            self._tracing = False
-            if self.on_trace_ready is not None:
-                self.on_trace_ready(self)
+        self._finish_trace()
         self.current_state = ProfilerState.CLOSED
 
     def step(self):

@@ -71,6 +71,8 @@ what doesn't:
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -79,11 +81,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import flags as _flags
+from .. import observability as _obs
 from ..models.generation import _place_on_mesh, init_kv_cache, sample_tokens
 from ..nn.layer import bind_params
 from .kv_cache import BlockManager, init_paged_kv_cache
 
 __all__ = ["ServingEngine", "SamplingParams", "Request"]
+
+# engine instances share the default registry; the ``engine`` label keeps
+# their series (and retrace budgets) independent
+_ENGINE_IDS = itertools.count()
+
+# one compiled prefill program per power-of-two bucket (plus the paged
+# suffix buckets) — generous static ceiling for the prefill trace budget
+_PREFILL_TRACE_BUDGET = 16
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,12 +117,14 @@ class Request:
     prompt: np.ndarray                 # (plen,) int32
     max_new_tokens: int
     sampling: SamplingParams
+    t_submit: float = 0.0              # perf_counter at submit (SLO clock)
 
 
 @dataclasses.dataclass
 class _Slot:
     rid: int
     remaining: int                     # new tokens still allowed
+    t_first: float = 0.0               # perf_counter at first token (TPOT)
 
 
 class ServingEngine:
@@ -155,6 +168,7 @@ class ServingEngine:
         self.prefill_batch = int(prefill_batch)
         self.paged = bool(_flags.flag("serving_paged_kv")
                           if paged is None else paged)
+        self._init_metrics()
 
         # quantized-decode hooks, exactly as models/generation.py binds
         self._bind = getattr(model, "unwrapped", model)
@@ -179,10 +193,9 @@ class ServingEngine:
                                     np.int32)
             # COW device copy (compiled once; only dispatched when a
             # shared block is about to be written — see kv_cache.py)
-            self._cow_fn = jax.jit(
-                lambda c, src, dst: c.at[:, :, dst].set(c[:, :, src]))
-            self.prefill_tokens_computed = 0   # pads excluded; proves the
-            self.prefill_tokens_total = 0      # prefix cache skips work
+            self._cow_fn = _obs.track_retraces(
+                lambda c, src, dst: c.at[:, :, dst].set(c[:, :, src]),
+                "serving.cow", labels={"engine": self._eid})
         else:
             cache = init_kv_cache(model.config, self.num_slots,
                                   self.max_length)
@@ -207,18 +220,92 @@ class ServingEngine:
         self._next_rid = 0
         self._base_key = jax.random.key(seed)
         self._ticks = 0
-        self.last_occupancy = 0        # busy slots at the last decode tick
-        # trace counters: python side effects fire at TRACE time only, so
-        # these count compilations, not calls — the step-level-jit-reuse
-        # claim is testable (tests assert step_traces == 1)
-        self.step_traces = 0
-        self.prefill_traces = 0
+        # trace accounting rides the retrace watchdog
+        # (observability/watchdog.py): the wrapper counts compilations —
+        # python side effects fire at TRACE time only — into the shared
+        # registry and BUDGETS them; the step function's budget of 1 is
+        # the continuous-batching contract itself, enforced at the
+        # moment a retrace happens instead of asserted after the fact.
+        # ``step_traces``/``prefill_traces`` read through to the counters.
+        lbl = {"engine": self._eid}
         if self.paged:
-            self._step_fn = jax.jit(self._step_impl_paged)
-            self._prefill_fn = jax.jit(self._prefill_impl_paged)
+            self._step_fn = _obs.track_retraces(
+                self._step_impl_paged, "serving.step", budget=1, labels=lbl)
+            self._prefill_fn = _obs.track_retraces(
+                self._prefill_impl_paged, "serving.prefill",
+                budget=_PREFILL_TRACE_BUDGET, labels=lbl)
         else:
-            self._step_fn = jax.jit(self._step_impl)
-            self._prefill_fn = jax.jit(self._prefill_impl)
+            self._step_fn = _obs.track_retraces(
+                self._step_impl, "serving.step", budget=1, labels=lbl)
+            self._prefill_fn = _obs.track_retraces(
+                self._prefill_impl, "serving.prefill",
+                budget=_PREFILL_TRACE_BUDGET, labels=lbl)
+
+    def _init_metrics(self):
+        """Declare this engine's series in the shared registry (metric
+        name conventions: README "Observability").  One ``engine=<id>``
+        label keeps concurrent engines' series and retrace budgets
+        independent; every hot-path update below is O(1) host work."""
+        reg = _obs.default_registry()
+        self._eid = str(next(_ENGINE_IDS))
+        self._tracer = _obs.get_tracer()
+        lbl = {"engine": self._eid}
+        hist, ctr, gauge = reg.histogram, reg.counter, reg.gauge
+        self._m_queue_wait = hist(
+            "serving.queue_wait_ms",
+            "submit → admission wait per request").labels(**lbl)
+        self._m_ttft = hist(
+            "serving.ttft_ms",
+            "time to first token: submit → first sampled token "
+            "fetched").labels(**lbl)
+        self._m_tpot = hist(
+            "serving.tpot_ms",
+            "per-token decode latency per finished request: "
+            "(t_last - t_first) / (tokens - 1)").labels(**lbl)
+        self._m_step_ms = hist(
+            "serving.decode_step_ms",
+            "wall time of one jitted decode step incl. the (num_slots,) "
+            "token fetch").labels(**lbl)
+        self._m_active = gauge(
+            "serving.active_slots",
+            "busy slots at the last scheduler tick").labels(**lbl)
+        self._m_occ = gauge(
+            "serving.slot_occupancy",
+            "active_slots / num_slots at the last tick").labels(**lbl)
+        self._m_submitted = ctr(
+            "serving.requests_submitted", "submit() calls").labels(**lbl)
+        self._m_finished = ctr(
+            "serving.requests_finished",
+            "requests retired (all reasons)").labels(**lbl)
+        self._f_retired = ctr(
+            "serving.retired",
+            "retirements by reason: eos | max_new_tokens | max_length")
+        self._m_tokens = ctr(
+            "serving.tokens_generated",
+            "sampled tokens returned to requests (prefill first tokens "
+            "included)").labels(**lbl)
+        self._f_bucket = ctr(
+            "serving.prefill_bucket",
+            "admission waves per padded prefill bucket length (paged: "
+            "suffix bucket)")
+        self._m_waves = ctr(
+            "serving.prefill_waves", "batched prefill waves").labels(**lbl)
+        self._m_blocked = ctr(
+            "serving.admission_blocked",
+            "admission attempts deferred because the paged pool could "
+            "not cover the request yet").labels(**lbl)
+        self._m_prefill_computed = ctr(
+            "serving.prefill_tokens_computed",
+            "prompt tokens actually prefilled (pads excluded; prefix "
+            "hits skip these)").labels(**lbl)
+        self._m_prefill_total = ctr(
+            "serving.prefill_tokens_total",
+            "prompt tokens submitted across admitted requests").labels(
+                **lbl)
+        self._m_step_traces = ctr(
+            "jit.traces", "").labels(site="serving.step", **lbl)
+        self._m_prefill_traces = ctr(
+            "jit.traces", "").labels(site="serving.prefill", **lbl)
 
     # -- jitted device programs -------------------------------------------
 
@@ -226,7 +313,6 @@ class ServingEngine:
                    temps, topk, topp, key):
         """One decode step for ALL slots: row i holds request state at
         position ``positions[i]``.  Compiled exactly once."""
-        self.step_traces += 1
         with bind_params(self._bind, self._prepare(params)):
             logits, cache = self.model.decode_step(
                 tokens[:, None], cache, positions)
@@ -243,7 +329,6 @@ class ServingEngine:
         cache rows into their slots.  Dummy rows carry ``slot_id ==
         num_slots``; the ``mode="drop"`` scatter discards them.  One
         compilation per padded prompt-bucket length."""
-        self.prefill_traces += 1
         nb = ids.shape[0]
         sub = init_kv_cache(self.config, nb, self.max_length)
         with bind_params(self._bind, self._prepare(params)):
@@ -259,7 +344,6 @@ class ServingEngine:
         rides along as a traced input, so allocation changes (slots
         deepening into fresh blocks, prefix adoptions, evictions) reach
         the device as data.  Compiled exactly once."""
-        self.step_traces += 1
         with bind_params(self._bind, self._prepare(params)):
             logits, cache = self.model.decode_step(
                 tokens[:, None], cache, positions, block_tables=tables)
@@ -279,7 +363,6 @@ class ServingEngine:
         every layer's scatter precedes its attention read).  The first
         token samples from the logits at each row's last REAL suffix
         position.  One compilation per padded suffix-bucket length."""
-        self.prefill_traces += 1
         nb = ids.shape[0]
         with bind_params(self._bind, self._prepare(params)):
             logits, cache = self.model.decode_step(
@@ -316,7 +399,9 @@ class ServingEngine:
         self._next_rid += 1
         self._results[rid] = []
         self._queue.append(Request(rid, prompt, int(max_new_tokens),
-                                   sampling or SamplingParams()))
+                                   sampling or SamplingParams(),
+                                   t_submit=time.perf_counter()))
+        self._m_submitted.inc()
         return rid
 
     def step(self) -> List[int]:
@@ -328,43 +413,53 @@ class ServingEngine:
         server waiting for traffic) return immediately: no admission
         scan, no device dispatch of a fully-masked decode step."""
         if not self._queue and not self._active.any():
-            self.last_occupancy = 0
+            self._set_occupancy(0)
             return []
+        with self._tracer.span("serving.step", tick=self._ticks):
+            return self._step_inner()
+
+    def _step_inner(self) -> List[int]:
         finished = self._admit()
-        self.last_occupancy = int(self._active.sum())
-        if not self._active.any():
+        occ = int(self._active.sum())
+        self._set_occupancy(occ)
+        if not occ:
             return finished
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
-        if self.paged:
-            for i, slot in enumerate(self._slots):
-                if slot is None:
-                    continue
-                # this tick writes K/V at positions[i]: grow the chain
-                # over the block boundary and COW-privatise it (a no-op
-                # unless a forking feature shared the tail block)
-                pos = int(self._positions[i])
-                grew = self.kv.ensure_capacity(i, pos)
-                cow = self.kv.ensure_writable(i, pos // self.block_len)
-                if cow is not None:
-                    self._cache = self._cow_fn(self._cache,
-                                               jnp.int32(cow[0]),
-                                               jnp.int32(cow[1]))
-                if grew or cow is not None:
-                    self._tables[i] = self.kv.table_row(i, self.max_blocks)
-            nxt, self._cache = self._step_fn(
-                self._params, self._cache,
-                jnp.asarray(self._tokens), jnp.asarray(self._positions),
-                jnp.asarray(self._tables), jnp.asarray(self._active),
-                jnp.asarray(self._temps), jnp.asarray(self._topk),
-                jnp.asarray(self._topp), key)
-        else:
-            nxt, self._cache = self._step_fn(
-                self._params, self._cache,
-                jnp.asarray(self._tokens), jnp.asarray(self._positions),
-                jnp.asarray(self._active), jnp.asarray(self._temps),
-                jnp.asarray(self._topk), jnp.asarray(self._topp), key)
-        nxt = np.asarray(nxt)
+        t0 = time.perf_counter()
+        with self._tracer.span("serving.decode", slots=occ):
+            if self.paged:
+                for i, slot in enumerate(self._slots):
+                    if slot is None:
+                        continue
+                    # this tick writes K/V at positions[i]: grow the chain
+                    # over the block boundary and COW-privatise it (a no-op
+                    # unless a forking feature shared the tail block)
+                    pos = int(self._positions[i])
+                    grew = self.kv.ensure_capacity(i, pos)
+                    cow = self.kv.ensure_writable(i, pos // self.block_len)
+                    if cow is not None:
+                        self._cache = self._cow_fn(self._cache,
+                                                   jnp.int32(cow[0]),
+                                                   jnp.int32(cow[1]))
+                    if grew or cow is not None:
+                        self._tables[i] = self.kv.table_row(i,
+                                                            self.max_blocks)
+                nxt, self._cache = self._step_fn(
+                    self._params, self._cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                    jnp.asarray(self._tables), jnp.asarray(self._active),
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), key)
+            else:
+                nxt, self._cache = self._step_fn(
+                    self._params, self._cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                    jnp.asarray(self._active), jnp.asarray(self._temps),
+                    jnp.asarray(self._topk), jnp.asarray(self._topp), key)
+            nxt = np.asarray(nxt)        # the tick's one host sync
+        now = time.perf_counter()
+        self._m_step_ms.observe((now - t0) * 1e3)
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -373,9 +468,11 @@ class ServingEngine:
             self._tokens[i] = tok
             self._results[slot.rid].append(tok)
             slot.remaining -= 1
-            if self._done(tok, slot, i):
+            self._m_tokens.inc()
+            reason = self._finish_reason(tok, slot, i)
+            if reason is not None:
                 finished.append(slot.rid)
-                self._release(i)
+                self._retire(slot, i, reason, now)
         return finished
 
     def drain(self) -> List[Tuple[int, List[int]]]:
@@ -399,6 +496,92 @@ class ServingEngine:
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    # -- telemetry (registry read-throughs + snapshot) ---------------------
+
+    @property
+    def step_traces(self) -> int:
+        """Compilations of the step function (jit.traces read-through;
+        the continuous-batching contract is exactly 1)."""
+        return int(self._m_step_traces.value())
+
+    @property
+    def prefill_traces(self) -> int:
+        """Compilations of the prefill function (one per padded bucket
+        length actually seen)."""
+        return int(self._m_prefill_traces.value())
+
+    @property
+    def last_occupancy(self) -> int:
+        """Busy slots at the last scheduler tick (gauge read-through)."""
+        return int(self._m_active.value())
+
+    @property
+    def prefill_tokens_computed(self) -> int:
+        """Prompt tokens actually prefilled (pads excluded; paged prefix
+        hits skip these — computed < total proves the cache worked)."""
+        return int(self._m_prefill_computed.value())
+
+    @property
+    def prefill_tokens_total(self) -> int:
+        return int(self._m_prefill_total.value())
+
+    def metrics(self) -> Dict[str, object]:
+        """This engine's serving-SLO metrics read from the shared
+        registry: TTFT/TPOT/queue-wait/step-latency percentiles, slot
+        occupancy, request/token counters, trace counts, and (paged) the
+        pool's cache-accounting block.  ``bench.py --sections serving``
+        embeds exactly this dict; ``observability.snapshot()`` is the
+        full-process superset."""
+        def hist(h):
+            d = {"count": h.count}
+            for q, k in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                p = h.percentile(q)
+                if p is not None:
+                    d[k] = round(p, 3)
+            return d
+
+        out = {"ttft_ms": hist(self._m_ttft),
+               "tpot_ms": hist(self._m_tpot),
+               "queue_wait_ms": hist(self._m_queue_wait),
+               "decode_step_ms": hist(self._m_step_ms),
+               "slot_occupancy": round(self._m_occ.value(), 3),
+               "requests_submitted": int(self._m_submitted.value()),
+               "requests_finished": int(self._m_finished.value()),
+               "tokens_generated": int(self._m_tokens.value()),
+               "prefill_waves": int(self._m_waves.value()),
+               "step_traces": self.step_traces,
+               "prefill_traces": self.prefill_traces}
+        if self.paged:
+            st = self.kv.stats
+            total = self.prefill_tokens_total
+            out["kv_cache"] = {
+                "blocks_in_use": self.kv.blocks_in_use(),
+                "peak_blocks_in_use": st["peak_blocks_in_use"],
+                "peak_pool_occupancy": round(
+                    st["peak_blocks_in_use"] / self.kv.usable_blocks, 3),
+                "prefix_hit_tokens": st["prefix_hit_tokens"],
+                "prefix_hit_rate": round(st["prefix_hit_tokens"] / total,
+                                         3) if total else 0.0,
+                "evictions": st["evictions"],
+                "cow_copies": st["cow_copies"],
+                "admission_blocked": int(self._m_blocked.value())}
+        return out
+
+    def _set_occupancy(self, n: int):
+        self._m_active.set(n)
+        self._m_occ.set(n / self.num_slots if self.num_slots else 0.0)
+
+    def _retire(self, slot: _Slot, i: int, reason: str, now: float):
+        """Per-request SLO readout at retirement, then release the slot.
+        TPOT = decode time per token after the first (prefill excluded),
+        the complement of TTFT in the usual serving-latency split."""
+        n = len(self._results[slot.rid])
+        if n > 1 and slot.t_first > 0.0:
+            self._m_tpot.observe((now - slot.t_first) * 1e3 / (n - 1))
+        self._m_finished.inc()
+        self._f_retired.labels(engine=self._eid, reason=reason).inc()
+        self._release(i)
 
     # -- scheduler internals ----------------------------------------------
 
@@ -454,6 +637,9 @@ class ServingEngine:
                 m = self.kv.admit(si, req.prompt, req.prompt.size,
                                   req.max_new_tokens)
                 if m is None:          # pool full: wait for retirements
+                    self._m_blocked.inc()
+                    self._tracer.instant("serving.admission_blocked",
+                                         rid=req.request_id)
                     break
                 self._queue.popleft()
                 self._tables[si] = self.kv.table_row(si, self.max_blocks)
@@ -465,6 +651,7 @@ class ServingEngine:
 
     def _prefill_wave_paged(self, wave: List[Tuple[Request, int, int]]
                             ) -> List[int]:
+        t_adm = time.perf_counter()
         nb = self.prefill_batch
         bucket = min(max(self._bucket(req.prompt.size - m)
                          for req, _, m in wave), self.max_length)
@@ -486,18 +673,26 @@ class ServingEngine:
             temps[r] = req.sampling.temperature
             topk[r] = req.sampling.top_k
             topp[r] = req.sampling.top_p
-            self.prefill_tokens_computed += int(suffix.size)
-            self.prefill_tokens_total += int(req.prompt.size)
+            self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
+            self._m_prefill_computed.inc(int(suffix.size))
+            self._m_prefill_total.inc(int(req.prompt.size))
+        self._m_waves.inc()
+        self._f_bucket.labels(engine=self._eid, bucket=str(bucket)).inc()
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
-        tok, self._cache = self._prefill_fn(
-            self._params, self._cache, jnp.asarray(ids),
-            jnp.asarray(prefix), jnp.asarray(slens), jnp.asarray(tables),
-            jnp.asarray(temps), jnp.asarray(topk), jnp.asarray(topp), key)
-        tok = np.asarray(tok)
+        with self._tracer.span("serving.prefill", bucket=bucket,
+                               rows=len(wave)):
+            tok, self._cache = self._prefill_fn(
+                self._params, self._cache, jnp.asarray(ids),
+                jnp.asarray(prefix), jnp.asarray(slens),
+                jnp.asarray(tables), jnp.asarray(temps),
+                jnp.asarray(topk), jnp.asarray(topp), key)
+            tok = np.asarray(tok)
+        t_tok = time.perf_counter()
         finished: List[int] = []
         for r, (req, si, m) in enumerate(wave):
-            slot = _Slot(req.request_id, req.max_new_tokens - 1)
+            slot = _Slot(req.request_id, req.max_new_tokens - 1,
+                         t_first=t_tok)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = tok[r]
@@ -506,13 +701,17 @@ class ServingEngine:
             self._topk[si] = topk[r]
             self._topp[si] = topp[r]
             self._results[req.request_id].append(int(tok[r]))
-            if self._done(int(tok[r]), slot, si):
+            self._m_tokens.inc()
+            self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
+            reason = self._finish_reason(int(tok[r]), slot, si)
+            if reason is not None:
                 finished.append(req.request_id)
-                self._release(si)
+                self._retire(slot, si, reason, t_tok)
         return finished
 
     def _prefill_wave(self, wave: List[Request], slots: List[int],
                       bucket: int) -> List[int]:
+        t_adm = time.perf_counter()
         nb = self.prefill_batch
         ids = np.full((nb, bucket), self.pad_token_id, np.int32)
         plens = np.ones((nb,), np.int32)
@@ -528,16 +727,26 @@ class ServingEngine:
             temps[r] = req.sampling.temperature
             topk[r] = req.sampling.top_k
             topp[r] = req.sampling.top_p
+            self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
+            self._m_prefill_computed.inc(int(req.prompt.size))
+            self._m_prefill_total.inc(int(req.prompt.size))
+        self._m_waves.inc()
+        self._f_bucket.labels(engine=self._eid, bucket=str(bucket)).inc()
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
-        tok, self._cache = self._prefill_fn(
-            self._params, self._cache, jnp.asarray(ids), jnp.asarray(plens),
-            jnp.asarray(slot_ids), jnp.asarray(temps), jnp.asarray(topk),
-            jnp.asarray(topp), key)
-        tok = np.asarray(tok)
+        with self._tracer.span("serving.prefill", bucket=bucket,
+                               rows=len(wave)):
+            tok, self._cache = self._prefill_fn(
+                self._params, self._cache, jnp.asarray(ids),
+                jnp.asarray(plens), jnp.asarray(slot_ids),
+                jnp.asarray(temps), jnp.asarray(topk),
+                jnp.asarray(topp), key)
+            tok = np.asarray(tok)
+        t_tok = time.perf_counter()
         finished: List[int] = []
         for r, (req, si) in enumerate(zip(wave, slots)):
-            slot = _Slot(req.request_id, req.max_new_tokens - 1)
+            slot = _Slot(req.request_id, req.max_new_tokens - 1,
+                         t_first=t_tok)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = tok[r]
@@ -546,16 +755,25 @@ class ServingEngine:
             self._topk[si] = topk[r]
             self._topp[si] = topp[r]
             self._results[req.request_id].append(int(tok[r]))
-            if self._done(int(tok[r]), slot, si):
+            self._m_tokens.inc()
+            self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
+            reason = self._finish_reason(int(tok[r]), slot, si)
+            if reason is not None:
                 finished.append(req.request_id)
-                self._release(si)
+                self._retire(slot, si, reason, t_tok)
         return finished
 
-    def _done(self, tok: int, slot: _Slot, i: int) -> bool:
-        return (slot.remaining <= 0
-                or (self.eos_token_id is not None
-                    and tok == self.eos_token_id)
-                or int(self._positions[i]) >= self.max_length)
+    def _finish_reason(self, tok: int, slot: _Slot,
+                       i: int) -> Optional[str]:
+        """None while the request keeps going, else the retirement
+        reason (the ``serving.retired`` counter's label)."""
+        if self.eos_token_id is not None and tok == self.eos_token_id:
+            return "eos"
+        if slot.remaining <= 0:
+            return "max_new_tokens"
+        if int(self._positions[i]) >= self.max_length:
+            return "max_length"
+        return None
 
     def _release(self, i: int):
         if self.paged:

@@ -73,15 +73,51 @@ block serves any slot), so the check is exact.
 
 from __future__ import annotations
 
+import itertools
 from collections import OrderedDict, deque
+from collections.abc import Mapping
 from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
+
+from .. import observability as _obs
 
 __all__ = ["BlockManager", "NULL_BLOCK", "init_paged_kv_cache"]
 
 NULL_BLOCK = 0          # physical block 0: pad/dummy scratch, never allocated
 _ROOT = -1              # trie parent id of a prompt's first block
+
+# pool instances share the default registry; the ``pool`` label keeps
+# their series independent
+_POOL_IDS = itertools.count()
+
+
+class _StatsView(Mapping):
+    """The historical ``BlockManager.stats`` dict, now a live read-through
+    over the shared metrics registry — same keys, same int values, so
+    ``m.stats["evictions"]`` keeps working while the counters flow into
+    ``observability.snapshot()`` / Prometheus exposition like everything
+    else."""
+
+    _KEYS = ("prefix_lookups", "prefix_hit_blocks", "prefix_hit_tokens",
+             "evictions", "cow_copies", "peak_blocks_in_use")
+
+    def __init__(self, mgr: "BlockManager"):
+        self._mgr = mgr
+
+    def __getitem__(self, key: str) -> int:
+        if key == "peak_blocks_in_use":
+            return self._mgr._peak
+        return int(self._mgr._counters[key].value())
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def __repr__(self):
+        return repr(dict(self))
 
 
 def init_paged_kv_cache(config, num_blocks: int, block_len: int, dtype=None):
@@ -133,9 +169,56 @@ class BlockManager:
         self._block_key: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
         self._children: Dict[int, Set[int]] = {}
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # ref==0 cached
-        self.stats = {"prefix_lookups": 0, "prefix_hit_blocks": 0,
-                      "prefix_hit_tokens": 0, "evictions": 0,
-                      "cow_copies": 0, "peak_blocks_in_use": 0}
+        # telemetry: counters + pool gauges in the shared registry
+        # (labelled pool=<id>); ``stats`` stays the public readout as a
+        # live Mapping view over them
+        reg = _obs.default_registry()
+        self._pid = str(next(_POOL_IDS))
+        lbl = {"pool": self._pid}
+        self._counters = {
+            "prefix_lookups": reg.counter(
+                "kv_cache.prefix_lookups",
+                "admissions that consulted the prefix trie").labels(**lbl),
+            "prefix_hit_blocks": reg.counter(
+                "kv_cache.prefix_hit_blocks",
+                "blocks adopted from the prefix cache instead of "
+                "recomputed").labels(**lbl),
+            "prefix_hit_tokens": reg.counter(
+                "kv_cache.prefix_hit_tokens",
+                "tokens adopted from the prefix cache").labels(**lbl),
+            "evictions": reg.counter(
+                "kv_cache.evictions",
+                "cached blocks reclaimed under pool pressure").labels(
+                    **lbl),
+            "cow_copies": reg.counter(
+                "kv_cache.cow_copies",
+                "ensure_writable copy-on-write copies").labels(**lbl),
+        }
+        self._peak = 0
+        self._g_peak = reg.gauge(
+            "kv_cache.peak_blocks_in_use",
+            "high-water mark of referenced blocks").labels(**lbl)
+        self._g_in_use = reg.gauge(
+            "kv_cache.blocks_in_use",
+            "blocks referenced by at least one live chain").labels(**lbl)
+        self._g_occ = reg.gauge(
+            "kv_cache.pool_occupancy",
+            "blocks_in_use / usable_blocks").labels(**lbl)
+        self._g_free = reg.gauge(
+            "kv_cache.free_blocks", "free-list length").labels(**lbl)
+        self._g_cached = reg.gauge(
+            "kv_cache.cached_blocks",
+            "retired prefix blocks parked for future hits "
+            "(evictable)").labels(**lbl)
+        self._stats_view = _StatsView(self)
+        self._refresh_gauges()
+
+    @property
+    def stats(self) -> Mapping:
+        """Counter readout (``prefix_lookups``/``prefix_hit_blocks``/
+        ``prefix_hit_tokens``/``evictions``/``cow_copies``/
+        ``peak_blocks_in_use``) — a live view over the registry series."""
+        return self._stats_view
 
     # -- accounting --------------------------------------------------------
 
@@ -185,7 +268,7 @@ class BlockManager:
         prompt = [int(t) for t in prompt[:prompt_len]]
         matched: List[int] = []
         if self.prefix_cache:
-            self.stats["prefix_lookups"] += 1
+            self._counters["prefix_lookups"].inc()
             parent = _ROOT
             for b in range((prompt_len - 1) // bl):
                 bid = self._trie.get((parent, tuple(prompt[b * bl:
@@ -215,8 +298,8 @@ class BlockManager:
             self._append_block(st)
         if self.prefix_cache:
             self._register_prompt(st.chain, prompt, prompt_len)
-        self.stats["prefix_hit_blocks"] += m
-        self.stats["prefix_hit_tokens"] += m * bl
+        self._counters["prefix_hit_blocks"].inc(m)
+        self._counters["prefix_hit_tokens"].inc(m * bl)
         self._note_peak()
         return m * bl
 
@@ -285,7 +368,7 @@ class BlockManager:
         self._ref[src] -= 1
         self._ref[dst] = 1
         st.chain[logical_block] = dst
-        self.stats["cow_copies"] += 1
+        self._counters["cow_copies"].inc()
         self._note_peak()
         return src, dst
 
@@ -306,6 +389,7 @@ class BlockManager:
                     self._lru.move_to_end(bid)
                 else:
                     self._free.append(bid)
+        self._refresh_gauges()
 
     def _evict_one(self) -> int:
         """Reclaim the LRU cached block.  Unregistering cascades through
@@ -317,7 +401,7 @@ class BlockManager:
                 "KV block pool exhausted: no free or evictable blocks "
                 "(reservation accounting should have prevented this)")
         bid, _ = self._lru.popitem(last=False)
-        self.stats["evictions"] += 1
+        self._counters["evictions"].inc()
         stack = [bid]
         while stack:
             b = stack.pop()
@@ -348,6 +432,16 @@ class BlockManager:
         return list(self._slots[slot].chain)
 
     def _note_peak(self):
+        used = self._refresh_gauges()
+        if used > self._peak:
+            self._peak = used
+            self._g_peak.set(used)
+
+    def _refresh_gauges(self) -> int:
+        """Push the pool-occupancy gauges; returns blocks_in_use."""
         used = self.blocks_in_use()
-        if used > self.stats["peak_blocks_in_use"]:
-            self.stats["peak_blocks_in_use"] = used
+        self._g_in_use.set(used)
+        self._g_occ.set(used / self.usable_blocks)
+        self._g_free.set(len(self._free))
+        self._g_cached.set(len(self._lru))
+        return used

@@ -63,6 +63,26 @@ def _fresh_seed():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _observability_guard():
+    """Observability isolation + the retrace watchdog ARMED.
+
+    Every test starts from an empty metrics registry / span buffer, and
+    FLAGS_retrace_watchdog is flipped from its 'warn' default to
+    'raise': any track_retraces call-site that compiles past its budget
+    — most importantly the serving engines' once-jitted step functions
+    (budget 1) — raises RetraceError inside the offending trace, so a
+    future retrace regression fails tier-1 loudly instead of silently
+    recompiling per request."""
+    from paddle_tpu import flags, observability
+
+    observability.reset()
+    old = flags.flag("retrace_watchdog")
+    flags.set_flags({"retrace_watchdog": "raise"})
+    yield
+    flags.set_flags({"retrace_watchdog": old})
+
+
 @pytest.fixture
 def mesh8():
     import numpy as np

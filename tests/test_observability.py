@@ -1,0 +1,434 @@
+"""Unified metrics + tracing layer (paddle_tpu/observability).
+
+Four surfaces under test: the metrics registry (counters / gauges /
+fixed-bucket histograms, snapshot + Prometheus exposition), the host
+span tracer (Chrome-trace/Perfetto export), the retrace watchdog
+(track_retraces budgets), and the serving integration — a staggered
+engine trace must land TTFT/TPOT/queue-wait/occupancy in the shared
+registry and valid nested spans in the tracer, with the paged decode
+step compiling exactly once under the armed watchdog.
+"""
+
+import json
+import threading
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+MAXLEN = 64
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_counter_inc_labels_and_idempotent_family():
+    reg = MetricsRegistry()
+    c = reg.counter("t.hits", "help text")
+    c.inc()
+    c.inc(2)
+    c.labels(op="a").inc(5)
+    assert c.value() == 3
+    assert c.value(op="a") == 5
+    # re-declaration returns the same family; same labels → same child
+    assert reg.counter("t.hits") is c
+    assert c.labels(op="a") is c.labels(op="a")
+    with pytest.raises(ValueError, match="only go up"):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("t.g")
+    g.set(2.5)
+    g.inc()
+    g.dec(0.5)
+    assert g.value() == 3.0
+
+
+def test_kind_conflict_raises():
+    reg = MetricsRegistry()
+    reg.counter("t.x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("t.x")
+    reg.histogram("t.h", buckets=(1, 2))
+    with pytest.raises(ValueError, match="different buckets"):
+        reg.histogram("t.h", buckets=(1, 2, 3))
+    with pytest.raises(ValueError, match="bad metric name"):
+        reg.counter("bad name!")
+
+
+def test_histogram_buckets_counts_and_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("t.lat", buckets=(1.0, 2.0, 4.0, 8.0)).labels()
+    for v in (0.5, 1.5, 3.0, 3.0, 7.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(15.0)
+    # cumulative le counts
+    assert h.bucket_counts() == {"1": 1, "2": 2, "4": 4, "8": 5,
+                                 "+Inf": 5}
+    # rank 2.5 lands in the (2, 4] bucket holding observations 3 and 4:
+    # 2 + (4-2) * (2.5-2)/2 = 2.5
+    assert h.percentile(0.5) == pytest.approx(2.5)
+    assert h.percentile(1.0) == pytest.approx(8.0)
+    # values past the last finite bound clamp to it
+    h.observe(1000.0)
+    assert h.percentile(1.0) == pytest.approx(8.0)
+    with pytest.raises(ValueError):
+        h.percentile(1.5)
+
+
+def test_empty_histogram_percentile_is_none():
+    reg = MetricsRegistry()
+    assert reg.histogram("t.e").labels().percentile(0.5) is None
+
+
+def test_histogram_thread_safety_smoke():
+    reg = MetricsRegistry()
+    h = reg.histogram("t.mt", buckets=(10.0, 20.0)).labels()
+    c = reg.counter("t.mtc").labels()
+    n, per = 8, 2000
+
+    def work():
+        for i in range(per):
+            h.observe(float(i % 30))
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n * per           # no lost updates
+    assert c.value() == n * per
+    assert h.bucket_counts()["+Inf"] == n * per
+
+
+def test_snapshot_is_json_and_structured():
+    reg = MetricsRegistry()
+    reg.counter("t.c", "c help").labels(op="x").inc(3)
+    reg.gauge("t.g").set(1.5)
+    reg.histogram("t.h", buckets=(1.0,)).observe(0.5)
+    snap = reg.snapshot()
+    json.dumps(snap)                     # JSON-able end to end
+    assert snap["t.c"]["type"] == "counter"
+    assert snap["t.c"]["series"][0] == {"labels": {"op": "x"}, "value": 3}
+    assert snap["t.g"]["series"][0]["value"] == 1.5
+    hrow = snap["t.h"]["series"][0]
+    assert hrow["count"] == 1 and "p50" in hrow and "buckets" in hrow
+
+
+def test_prometheus_text_exposition():
+    reg = MetricsRegistry()
+    reg.counter("serving.requests", "total requests").labels(
+        engine="0").inc(7)
+    reg.gauge("kv_cache.pool_occupancy").set(0.25)
+    reg.histogram("serving.ttft_ms", buckets=(5.0, 10.0)).observe(7.0)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    assert "# HELP paddle_tpu_serving_requests_total total requests" in lines
+    assert "# TYPE paddle_tpu_serving_requests_total counter" in lines
+    assert 'paddle_tpu_serving_requests_total{engine="0"} 7' in lines
+    assert "paddle_tpu_kv_cache_pool_occupancy 0.25" in lines
+    assert "# TYPE paddle_tpu_serving_ttft_ms histogram" in lines
+    assert 'paddle_tpu_serving_ttft_ms_bucket{le="5"} 0' in lines
+    assert 'paddle_tpu_serving_ttft_ms_bucket{le="10"} 1' in lines
+    assert 'paddle_tpu_serving_ttft_ms_bucket{le="+Inf"} 1' in lines
+    assert "paddle_tpu_serving_ttft_ms_sum 7" in lines
+    assert "paddle_tpu_serving_ttft_ms_count 1" in lines
+
+
+# -- tracer ------------------------------------------------------------------
+
+def test_spans_nest_and_export_chrome_trace(tmp_path):
+    tr = obs.SpanTracer(max_events=100, enabled=True)
+    with tr.span("outer", tick=3):
+        with tr.span("inner"):
+            pass
+    tr.instant("marker", rid=1)
+    path = tmp_path / "trace.json"
+    tr.export_chrome_trace(str(path))
+    with open(path) as f:
+        trace = json.load(f)              # valid JSON on disk
+    evs = trace["traceEvents"]
+    by_name = {e["name"]: e for e in evs}
+    # metadata events Perfetto uses for track naming
+    assert by_name["process_name"]["ph"] == "M"
+    outer, inner = by_name["outer"], by_name["inner"]
+    for e in (outer, inner):
+        assert e["ph"] == "X"
+        for field in ("ts", "dur", "pid", "tid"):
+            assert field in e
+    # proper nesting: the child interval sits inside the parent's
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    assert inner["tid"] == outer["tid"]
+    assert outer["args"] == {"tick": 3}
+    assert by_name["marker"]["ph"] == "i"
+
+
+def test_tracer_ring_buffer_drops():
+    tr = obs.SpanTracer(max_events=3, enabled=True)
+    for i in range(5):
+        tr.instant(f"e{i}")
+    evs = tr.events()
+    assert len(evs) == 3
+    assert [e["name"] for e in evs] == ["e2", "e3", "e4"]  # oldest dropped
+    assert tr.dropped == 2
+    assert tr.export_chrome_trace()["otherData"]["dropped_events"] == 2
+
+
+def test_tracer_disabled_is_noop():
+    tr = obs.SpanTracer(max_events=10, enabled=False)
+    with tr.span("x"):
+        pass
+    tr.instant("y")
+    assert tr.events() == []
+
+
+def test_record_event_emits_host_span():
+    from paddle_tpu.profiler import RecordEvent
+
+    with RecordEvent("user_scope"):
+        pass
+    names = [e["name"] for e in obs.get_tracer().events()]
+    assert "user_scope" in names
+
+
+# -- retrace watchdog --------------------------------------------------------
+
+def _poly(x):
+    return x * 2
+
+
+def test_track_retraces_counts_and_raises_past_budget():
+    import jax.numpy as jnp
+
+    f = obs.track_retraces(_poly, "t.poly", budget=1)
+    f(jnp.ones((2,)))
+    f(jnp.ones((2,)))                    # same shape: cached, no retrace
+    assert f.traces == 1
+    # deliberately shape-polymorphic call: second compilation blows the
+    # budget; the conftest guard armed FLAGS_retrace_watchdog=raise
+    with pytest.raises(obs.RetraceError, match="trace #2 exceeds"):
+        f(jnp.ones((3,)))
+    assert f.traces == 2
+    # the registry carries the same count under the site label
+    assert obs.default_registry().counter("jit.traces").value(
+        site="t.poly") == 2
+
+
+def test_track_retraces_warn_and_off_modes():
+    import jax.numpy as jnp
+
+    pt.flags.set_flags({"retrace_watchdog": "warn"})
+    f = obs.track_retraces(_poly, "t.poly_warn", budget=1)
+    f(jnp.ones((2,)))
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        out = f(jnp.ones((3,)))          # retrace → warning, still runs
+    assert np.allclose(np.asarray(out), 2.0)
+    assert any(issubclass(w.category, obs.RetraceWarning) for w in got)
+    pt.flags.set_flags({"retrace_watchdog": "off"})
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        f(jnp.ones((4,)))
+    assert not any(issubclass(w.category, obs.RetraceWarning)
+                   for w in got)
+    assert f.traces == 3
+
+
+# -- profiler segment export -------------------------------------------------
+
+@pytest.fixture
+def fake_xla_trace(monkeypatch):
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    return calls
+
+
+def test_profiler_exports_once_per_cycle_segment(fake_xla_trace, tmp_path):
+    """repeat cycles: each RECORD..RECORD_AND_RETURN segment stops and
+    exports exactly once at its boundary (they used to merge), and
+    stop() after the final transition must not re-fire the handler."""
+    from paddle_tpu.profiler import Profiler, make_scheduler
+
+    fired = []
+    handler = lambda prof: fired.append(prof.step_num)  # noqa: E731
+    with Profiler(scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                           repeat=2),
+                  on_trace_ready=handler,
+                  log_dir=str(tmp_path)) as p:
+        for _ in range(4):
+            p.step()
+    assert fired == [2, 4]               # one export per cycle boundary
+    assert fake_xla_trace["start"] == 2
+    assert fake_xla_trace["stop"] == 2
+    p.stop()                             # extra stop: still no re-fire
+    assert len(fired) == 2
+
+
+def test_profiler_stop_after_record_and_return_exports_once(
+        fake_xla_trace, tmp_path):
+    from paddle_tpu.profiler import Profiler, ProfilerState
+
+    fired = []
+    p = Profiler(scheduler=lambda step: ProfilerState.RECORD_AND_RETURN,
+                 on_trace_ready=lambda prof: fired.append(True),
+                 log_dir=str(tmp_path))
+    p.start()
+    assert p.current_state is ProfilerState.RECORD_AND_RETURN
+    p.stop()
+    p.stop()
+    assert fired == [True]
+    assert fake_xla_trace["start"] == 1 and fake_xla_trace["stop"] == 1
+
+
+def test_profiler_handler_calling_stop_does_not_recurse(fake_xla_trace,
+                                                        tmp_path):
+    from paddle_tpu.profiler import Profiler
+
+    fired = []
+
+    def handler(prof):
+        fired.append(True)
+        prof.stop()                      # reentrant stop from the handler
+
+    p = Profiler(on_trace_ready=handler, log_dir=str(tmp_path))
+    p.start()
+    p.stop()
+    assert fired == [True]
+
+
+# -- serving integration -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm():
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+
+    pt.seed(7)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    return model
+
+
+def _prompt(n, seed):
+    return np.random.RandomState(seed).randint(0, 256, n).astype(np.int32)
+
+
+def test_engine_metrics_on_staggered_trace(lm, tmp_path):
+    """One staggered trace through the contiguous engine: every serving
+    SLO series lands in the shared registry, `metrics()` reads them
+    back, and the tracer's Chrome export is a valid nested trace."""
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN)
+    rids = [eng.submit(_prompt(5, 1), max_new_tokens=4),
+            eng.submit(_prompt(9, 2), max_new_tokens=4)]
+    eng.step()
+    eng.step()
+    rids.append(eng.submit(_prompt(7, 3), max_new_tokens=4))
+    rids.append(eng.submit(_prompt(6, 4), max_new_tokens=4))
+    results = dict(eng.drain())
+
+    m = eng.metrics()
+    n_tok = sum(len(results[r]) for r in rids)
+    assert m["requests_submitted"] == 4
+    assert m["requests_finished"] == 4
+    assert m["tokens_generated"] == n_tok
+    assert m["ttft_ms"]["count"] == 4 and m["ttft_ms"]["p50"] > 0
+    assert m["queue_wait_ms"]["count"] == 4
+    assert m["tpot_ms"]["count"] == 4    # every request decoded > 1 token
+    assert m["decode_step_ms"]["count"] >= 3
+    assert m["step_traces"] == 1         # armed watchdog would have raised
+    assert m["prefill_waves"] >= 2
+
+    # one snapshot() call tells the whole story (acceptance criterion)
+    snap = obs.snapshot()
+    assert snap["serving.ttft_ms"]["series"][0]["count"] == 4
+    assert snap["serving.active_slots"]["type"] == "gauge"
+    assert "jit.traces" in snap
+    # kernel-path counters: the decode dispatch decisions were counted
+    paths = {(r["labels"]["op"], r["labels"]["path"])
+             for r in snap["ops.kernel_path"]["series"]}
+    assert any(op == "decode_attention" for op, _ in paths)
+    # prefill-bucket distribution recorded per padded length
+    assert sum(r["value"]
+               for r in snap["serving.prefill_bucket"]["series"]) >= 2
+    # retirement reasons labelled
+    reasons = {r["labels"]["reason"]: r["value"]
+               for r in snap["serving.retired"]["series"]}
+    assert sum(reasons.values()) == 4
+
+    # Chrome-trace export of the same trace (Perfetto-loadable JSON)
+    path = tmp_path / "serving_trace.json"
+    obs.export_chrome_trace(str(path))
+    with open(path) as f:
+        trace = json.load(f)
+    steps = [e for e in trace["traceEvents"]
+             if e.get("name") == "serving.step"]
+    decodes = [e for e in trace["traceEvents"]
+               if e.get("name") == "serving.decode"]
+    prefills = [e for e in trace["traceEvents"]
+                if e.get("name") == "serving.prefill"]
+    assert steps and decodes and prefills
+    # each decode span nests inside some step span
+    for d in decodes:
+        assert any(s["ts"] <= d["ts"] and
+                   d["ts"] + d["dur"] <= s["ts"] + s["dur"] + 1e-6
+                   for s in steps)
+
+
+def test_paged_engine_metrics_and_zero_retraces(lm):
+    """Paged engine with a shared system prompt under the ARMED watchdog:
+    the step compiles exactly once across allocation churn, and the
+    pool's registry series carry the prefix-hit / occupancy story."""
+    from paddle_tpu.serving import ServingEngine
+
+    sys_p = _prompt(16, 9)
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN, paged=True,
+                        block_len=8)
+    r0 = eng.submit(np.concatenate([sys_p, _prompt(4, 10)]),
+                    max_new_tokens=4)
+    eng.drain()
+    r1 = eng.submit(np.concatenate([sys_p, _prompt(5, 11)]),
+                    max_new_tokens=4)
+    eng.drain()
+    assert eng.step_traces == 1          # watchdog budget=1 held
+    m = eng.metrics()
+    kv = m["kv_cache"]
+    assert kv["prefix_hit_tokens"] == 16          # two full shared blocks
+    assert 0 < kv["prefix_hit_rate"] < 1
+    assert kv["peak_blocks_in_use"] > 0
+    assert m["requests_finished"] == 2
+    # the engine-side token accounting proves the cache skipped work
+    assert eng.prefill_tokens_computed < eng.prefill_tokens_total
+    snap = obs.snapshot()
+    assert snap["kv_cache.prefix_hit_tokens"]["series"][0]["value"] == 16
+    assert "kv_cache.pool_occupancy" in snap
+    del r0, r1
+
+
+def test_block_manager_stats_are_registry_backed():
+    from paddle_tpu.serving.kv_cache import BlockManager
+
+    m = BlockManager(8, 4, prefix_cache=True)
+    assert m.admit(0, list(range(8)), 8, 4) == 0
+    assert dict(m.stats)["prefix_lookups"] == 1
+    assert m.stats["peak_blocks_in_use"] == 3     # ceil((8+1)/4) blocks
+    snap = obs.snapshot()
+    assert snap["kv_cache.prefix_lookups"]["series"][0]["value"] == 1
+    assert snap["kv_cache.blocks_in_use"]["series"][0]["value"] == 3
+    assert snap["kv_cache.free_blocks"]["series"][0]["value"] == 4
+    m.release(0)
+    assert obs.snapshot()["kv_cache.blocks_in_use"]["series"][0][
+        "value"] == 0
