@@ -164,6 +164,27 @@ DEFINE("kv_cache_num_blocks", 0,
 DEFINE("serving_prefix_cache", True,
        "register full prompt blocks in the paged cache's prefix trie and "
        "serve later prompts that share them without recompute")
+# chunked prefill (serving/engine.py mixed steps): Sarathi-style
+# iteration-level token budgeting — prompts stream into the decode step
+# as fixed-size chunks instead of stalling it with whole-prompt waves
+DEFINE("serving_chunked_prefill", False,
+       "ServingEngine default admission mode: False = wave prefill "
+       "(separate bucketed prefill programs), True = chunked prefill "
+       "(prompts split into FLAGS_serving_prefill_chunk-token chunks "
+       "folded into the once-jitted mixed decode step, so in-flight "
+       "decodes never stall behind a long prompt; engine constructor "
+       "arg overrides)")
+DEFINE("serving_prefill_chunk", 256,
+       "chunked-prefill token budget per scheduler tick: each mixed "
+       "step carries num_slots decode tokens plus one prompt chunk of "
+       "at most this many tokens.  Larger chunks finish prompts (TTFT) "
+       "faster; smaller chunks bound the per-tick latency bump in-flight "
+       "decodes see (TPOT).  Static — part of the compiled step shape")
+DEFINE("serving_chunk_policy", "prefill",
+       "mixed-step scheduling policy: 'prefill' schedules a pending "
+       "prompt chunk on every tick (fastest TTFT); 'decode' interleaves "
+       "— while any slot is decoding, chunks run on alternate ticks "
+       "only, halving prefill bandwidth to protect TPOT further")
 # observability (paddle_tpu/observability): metrics registry + span tracer
 DEFINE("retrace_watchdog", "warn",
        "action when a track_retraces call-site compiles past its trace "

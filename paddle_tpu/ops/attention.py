@@ -161,8 +161,12 @@ def _decode_attention_decision(b, s, hq, hkv, d, kv_len, has_extra_mask,
                             f"weight-stream bound there)")
     if hkv == 0 or hq % hkv:
         return "xla_math", f"q heads {hq} not a multiple of kv heads {hkv}"
-    if s * (hq // hkv) > 64:
-        return "xla_math", f"s*G = {s * (hq // hkv)} > 64 (prefill-shaped)"
+    if hq // hkv > 64:
+        return "xla_math", f"GQA group size {hq // hkv} > 64"
+    if s > 2048:
+        # a q longer than any serving prefill chunk is whole-prompt
+        # prefill — the flash kernel's regime, not the cached path's
+        return "xla_math", f"q_len {s} > 2048 (whole-prefill-shaped)"
     if d > 256:
         return "xla_math", f"head_dim {d} > 256"
     if paged_block_len is not None:

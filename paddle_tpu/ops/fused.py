@@ -182,7 +182,13 @@ def masked_multihead_attention(x, cache_kv, src_mask=None,
     is the masked math path over the cache, the serving-measured regime
     (BENCH_DECODE.json) for single-token queries.
     """
+    from . import _dispatch as _disp
     from .attention import NEG_INF
+
+    # one path today (the masked math pass is the measured serving regime
+    # for 1-token queries); counted so the op's dispatch is observable
+    # alongside every other _dispatch decision
+    _disp.count_kernel_path("masked_multihead_attention", "xla_math")
 
     two, b, h, max_len, d = cache_kv.shape
     assert two == 2
@@ -329,6 +335,22 @@ def fused_multi_transformer(
     n_layers = len(qkv_weights)
     new_caches = [] if cache_kvs is not None else None
     pos = 0 if time_step is None else time_step
+
+    # the attention-path decision is loop-invariant; count it ONCE per
+    # trace so ops.kernel_path{op="fused_multi_transformer"} says which
+    # regime each compiled stack took (same discipline as the
+    # attention/matmul dispatchers — a routing regression is a counter
+    # move, not a perf mystery)
+    from . import _dispatch as _disp
+    if cache_kvs is None:
+        _disp.count_kernel_path("fused_multi_transformer", "flash_causal")
+    elif isinstance(pos, int) and pos == 0 and s > 1 and attn_mask is None:
+        _disp.count_kernel_path("fused_multi_transformer", "flash_prefill")
+    elif attn_mask is None:
+        _disp.count_kernel_path("fused_multi_transformer", "cached_decode")
+    else:
+        _disp.count_kernel_path("fused_multi_transformer",
+                                "masked_reference")
 
     def ln(v, scales, biases, i):
         return F.layer_norm(v, [v.shape[-1]], scales[i],

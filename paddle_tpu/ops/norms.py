@@ -68,12 +68,17 @@ _rms_pallas_diffable.defvjp(_rms_fwd, _rms_bwd)
 
 def rms_norm(x, weight=None, epsilon: float = 1e-6):
     """Public entry (parity: fused_rms_norm).  Routes long rows to the
-    Pallas kernel on TPU; everything else to the XLA reference."""
+    Pallas kernel on TPU; everything else to the XLA reference.  Every
+    routing decision is counted into ``ops.kernel_path{op="rms_norm"}``
+    at trace time, like the attention/matmul dispatchers."""
     if (_dispatch.use_pallas()
             and x.shape[-1] >= flags.flag("rms_norm_pallas_min_dim")):
         try:
-            return _rms_pallas_diffable(x, weight, epsilon,
-                                        _dispatch.pallas_interpret())
+            out = _rms_pallas_diffable(x, weight, epsilon,
+                                       _dispatch.pallas_interpret())
+            _dispatch.count_kernel_path("rms_norm", "pallas")
+            return out
         except NotImplementedError:
             pass
+    _dispatch.count_kernel_path("rms_norm", "xla_reference")
     return rms_norm_reference(x, weight, epsilon)

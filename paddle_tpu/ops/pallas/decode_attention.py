@@ -43,6 +43,17 @@ The cross-chunk merge is the same LSE algebra the ring-attention path
 uses (ops/ring_attention.py ``merge_attention``), specialised to the
 running (m, l, acc) form since chunks arrive sequentially.
 
+**Chunked prefill** (serving/engine.py mixed steps): the same kernel
+generalises from q_len 1 to a q *chunk* — a span of prompt tokens
+attending its cached prefix plus its own causal self-block.  q is cut
+into tiles of ``bq`` tokens (``bq·G <= 64`` MXU rows each, sublane-padded
+per tile) walked by a second grid dimension; the per-row ``pos`` mask
+already encodes "key j visible to query offset si iff j <= pos + si", so
+prefix + self-block causality needs no new machinery, and the dead-tail
+clamp becomes per-tile (early q tiles skip the chunk's own later KV
+blocks — causal block skipping for free).  Routing for these shapes is
+counted under ``ops.kernel_path{op="chunked_prefill"}``.
+
 **Paged KV cache** (serving/kv_cache.py): the kernel also serves the
 block-table layout, where the cache is one pooled ``(num_blocks,
 block_len, Hkv, D)`` array and each row's logical positions are backed by
@@ -76,7 +87,8 @@ from ._compat import CompilerParams
 
 NEG_INF = -1e30
 _LANES = 128  # VPU lane width: m/l scratch rows are padded to this
-_MAX_Q_ROWS = 64  # s·G rows cap — beyond this the tile is prefill-shaped
+_MAX_Q_ROWS = 64  # per-TILE s·G row cap — larger q is tiled over the grid
+_MAX_Q_LEN = 2048  # beyond this the shape is whole-prefill, flash territory
 
 
 def _pick_block_kv(kv_len: int, cap: int) -> int:
@@ -89,12 +101,15 @@ def _pick_block_kv(kv_len: int, cap: int) -> int:
 
 
 def _kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
-            l_sc, *, scale, s, g, hkv, d, rows, rows_p, bk, chunks):
+            l_sc, *, scale, s, g, hkv, d, bq, tile_p, bk, chunks):
     del bt_ref  # consumed by the index maps, not the body
     bi = pl.program_id(0)
-    ki = pl.program_id(1)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
     pos_b = pos_ref[bi]
-    last_live = (pos_b + s - 1) // bk  # last chunk holding a visible key
+    # last chunk holding a key visible to ANY row of this q tile (query
+    # offsets qi·bq .. min((qi+1)·bq, s) - 1)
+    last_live = (pos_b + jnp.minimum((qi + 1) * bq, s) - 1) // bk
 
     @pl.when(ki == 0)
     def _init():
@@ -104,20 +119,23 @@ def _kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
 
     @pl.when(ki <= last_live)
     def _compute():
-        # key j visible to row r = si·g + gi iff j <= pos_b + si; rows
-        # beyond s·g are sublane padding (fully masked, out = 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (rows_p, bk), 1) + ki * bk
-        rr = jax.lax.broadcasted_iota(jnp.int32, (rows_p, bk), 0)
-        keep = (cols <= pos_b + rr // g) & (rr < rows)
+        # key j visible to tile row r = si·g + gi (si local to the tile)
+        # iff j <= pos_b + qi·bq + si; rows past bq·g are sublane padding
+        # and rows whose query offset runs past s are the last tile's
+        # ragged tail — both fully masked (out = 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (tile_p, bk), 1) + ki * bk
+        rr = jax.lax.broadcasted_iota(jnp.int32, (tile_p, bk), 0)
+        si = qi * bq + rr // g
+        keep = (cols <= pos_b + si) & (rr < bq * g) & (si < s)
         kv = k_ref[0]  # (bk, hkv·d) — one contiguous chunk, all kv heads
         vv = v_ref[0]
         for h in range(hkv):
-            qh = q_ref[0, h]                   # (rows_p, d)
+            qh = q_ref[0, h]                   # (tile_p, d)
             kh = kv[:, h * d:(h + 1) * d]      # static lane slice
             vh = vv[:, h * d:(h + 1) * d]
             sc = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # (rows_p, bk)
+                preferred_element_type=jnp.float32) * scale  # (tile_p, bk)
             sc = jnp.where(keep, sc, NEG_INF)
             m_prev = m_sc[h][:, :1]
             m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
@@ -188,12 +206,23 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
             f"q heads ({hq}) must be a multiple of kv heads ({hkv})")
     g = hq // hkv
     rows = s * g
-    if rows > _MAX_Q_ROWS:
+    if g > _MAX_Q_ROWS:
+        raise NotImplementedError(f"GQA group size {g} > {_MAX_Q_ROWS}")
+    if s > _MAX_Q_LEN:
         raise NotImplementedError(
-            f"s*G = {rows} > {_MAX_Q_ROWS}: prefill-shaped q tile belongs "
-            f"to the flash kernel")
+            f"q_len {s} > {_MAX_Q_LEN}: whole-prefill-shaped q belongs to "
+            f"the flash kernel")
     if d > 256:
         raise NotImplementedError(f"head_dim {d} > 256")
+    # q tiling: one grid step covers bq query tokens (bq·g MXU rows).
+    # s <= bq is the steady-decode / small-s case — nq == 1, exactly the
+    # original kernel.  Larger s (a chunked-prefill q chunk attending its
+    # paged prefix plus its own causal self-block) walks q tiles over a
+    # second grid dimension; the per-tile dead-tail clamp skips KV chunks
+    # past pos + (qi+1)·bq - 1, so early tiles also skip the chunk's own
+    # later keys — causal block skipping for free.
+    bq = min(s, max(1, _MAX_Q_ROWS // g))
+    nq = -(-s // bq)
     if scale is None:
         scale = d ** -0.5
     if block_tables is None:
@@ -216,61 +245,70 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
     chunks = kv_len // bk
     if live_len is not None:
         chunks = max(1, min(chunks, -(-int(live_len) // bk)))
-    rows_p = max(8, -(-rows // 8) * 8)  # sublane-pad the q tile
+    tile_p = max(8, -(-(bq * g) // 8) * 8)  # sublane-pad each q tile
     if getattr(pos, "ndim", 0) == 1:
         pos_arr = jnp.asarray(pos, jnp.int32)
     else:
         pos_arr = jnp.full((b,), pos, jnp.int32)
-    # grouped-GQA q tile: (B, Hkv, s·G, D), row r = si·g + gi
+    # grouped-GQA q layout: (B, Hkv, s·G, D), row r = si·g + gi — then cut
+    # into nq tiles of bq·g rows, each sublane-padded to tile_p, so one
+    # BlockSpec block == one padded tile at row offset qi·tile_p
     qg = q.reshape(b, s, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, hkv, rows, d)
-    if rows_p != rows:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_p - rows), (0, 0)))
+    if nq * bq * g != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, nq * bq * g - rows), (0, 0)))
+    qg = qg.reshape(b, hkv, nq, bq * g, d)
+    if tile_p != bq * g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, tile_p - bq * g),
+                          (0, 0)))
+    qg = qg.reshape(b, hkv, nq * tile_p, d)
 
     # past every eligibility gate: this trace builds the kernel — count
     # which cache layout it was built for (routing visibility, trace-time
-    # side effect only)
+    # side effect only); a tiled q walk is the chunked-prefill mode
     from .. import _dispatch as _disp
     _disp.count_kernel_path(
-        "decode_attention_kernel",
+        "chunked_prefill" if nq > 1 else "decode_attention_kernel",
         "paged" if block_tables is not None else "contiguous")
 
     kernel = functools.partial(
-        _kernel, scale=float(scale), s=s, g=g, hkv=hkv, d=d, rows=rows,
-        rows_p=rows_p, bk=bk, chunks=chunks)
+        _kernel, scale=float(scale), s=s, g=g, hkv=hkv, d=d, bq=bq,
+        tile_p=tile_p, bk=bk, chunks=chunks)
 
-    def kv_idx(bi, ki, pos_ref, bt_ref):
-        # clamp the LOGICAL chunk index to the row's last live block, then
-        # dereference the block table: dead-tail chunks re-map to the same
-        # physical block as the previous grid step → Pallas elides the
-        # DMA, so HBM traffic stops at this row's live prefix
-        return (bt_ref[bi, jnp.minimum(ki, (pos_ref[bi] + s - 1) // bk)],
-                0, 0)
+    def q_idx(bi, qi, ki, pos_ref, bt_ref):
+        return (bi, 0, qi, 0)
+
+    def kv_idx(bi, qi, ki, pos_ref, bt_ref):
+        # clamp the LOGICAL chunk index to this q tile's last live block,
+        # then dereference the block table: dead-tail chunks re-map to the
+        # same physical block as the previous grid step → Pallas elides
+        # the DMA, so HBM traffic stops at the tile's live prefix
+        last = (pos_ref[bi] + jnp.minimum((qi + 1) * bq, s) - 1) // bk
+        return (bt_ref[bi, jnp.minimum(ki, last)], 0, 0)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(b, chunks),
+            grid=(b, nq, chunks),
             in_specs=[
-                pl.BlockSpec((1, hkv, rows_p, d),
-                             lambda bi, ki, pos_ref, bt_ref: (bi, 0, 0, 0)),
+                pl.BlockSpec((1, hkv, tile_p, d), q_idx),
                 pl.BlockSpec((1, bk, hkv * d), kv_idx),
                 pl.BlockSpec((1, bk, hkv * d), kv_idx),
             ],
-            out_specs=pl.BlockSpec(
-                (1, hkv, rows_p, d),
-                lambda bi, ki, pos_ref, bt_ref: (bi, 0, 0, 0)),
+            out_specs=pl.BlockSpec((1, hkv, tile_p, d), q_idx),
             scratch_shapes=[
-                pltpu.VMEM((hkv, rows_p, d), jnp.float32),
-                pltpu.VMEM((hkv, rows_p, _LANES), jnp.float32),
-                pltpu.VMEM((hkv, rows_p, _LANES), jnp.float32),
+                pltpu.VMEM((hkv, tile_p, d), jnp.float32),
+                pltpu.VMEM((hkv, tile_p, _LANES), jnp.float32),
+                pltpu.VMEM((hkv, tile_p, _LANES), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rows_p, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, nq * tile_p, d), q.dtype),
         compiler_params=CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(pos_arr, bt, qg, k2, v2)
-    out = out[:, :, :rows].reshape(b, hkv, s, g, d).transpose(0, 2, 1, 3, 4)
+    out = out.reshape(b, hkv, nq, tile_p, d)[:, :, :, :bq * g]
+    out = out.reshape(b, hkv, nq * bq * g, d)[:, :, :rows]
+    out = out.reshape(b, hkv, s, g, d).transpose(0, 2, 1, 3, 4)
     return out.reshape(b, s, hq, d).astype(q.dtype)

@@ -66,10 +66,43 @@ what doesn't:
     kernel; the trade is recompute avoided vs kernel choice, and it wins
     whenever prefixes actually repeat.  Greedy outputs stay
     token-identical to the contiguous engine (tests/test_serving_paged.py).
+
+**Chunked prefill** (``chunked=True`` / FLAGS_serving_chunked_prefill):
+wave admission stalls every in-flight decode for a whole prompt's prefill
+latency (~90 ms at b=8, prompt 1024 per BENCH_DECODE.json) — the classic
+TPOT-spike / head-of-line-blocking failure Sarathi-Serve's chunked
+prefill and Orca's iteration-level scheduling target.  Chunked mode
+replaces the wave with a **token-budget scheduler**:
+
+  * each admitted prompt becomes a cursor (:class:`_Prefill`), not a
+    prefill dispatch; every tick runs ONE **mixed step** — all decode
+    rows advance one token AND at most one ``prefill_chunk``-token slice
+    of the prompt streams into its slot's cache (as decode-at-depth:
+    per-row positions, the flash-decode kernel's chunked q mode at long
+    caches).  The per-tick token budget is ``num_slots + prefill_chunk``,
+    so TPOT degrades by a bounded, chunk-sized amount instead of a
+    whole-prompt stall, and TTFT pipelines across ticks;
+  * the mixed step is jitted ONCE (chunk size static, budget-1
+    ``track_retraces`` site ``serving.step``); chunk-free ticks ride the
+    same program with a dummy chunk whose writes are steered harmless
+    (contiguous: positions past ``max_length`` drop out of the scatter;
+    paged: the all-null table lands them in the null block);
+  * ``chunk_policy`` trades the two SLOs: ``"prefill"`` (default) runs a
+    pending chunk every tick, ``"decode"`` interleaves chunks with
+    chunk-free ticks while decodes are active;
+  * paged composition: admission adopts cached prefix blocks (the cursor
+    starts past them), chains grow per chunk, and full prompt blocks are
+    trie-registered only AFTER the chunk writing them is dispatched —
+    an unwritten block can never satisfy a prefix lookup.
+
+Greedy outputs remain token-identical to the wave engine (and therefore
+to ``greedy_generate``) — tests/test_serving.py staggered traces with a
+long prompt arriving mid-decode assert it for both cache layouts.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import time
@@ -127,6 +160,16 @@ class _Slot:
     t_first: float = 0.0               # perf_counter at first token (TPOT)
 
 
+@dataclasses.dataclass
+class _Prefill:
+    """A partially-prefilled request (chunked mode): admitted to a slot,
+    its prompt streaming into the cache one chunk per mixed step."""
+
+    req: Request
+    slot: int
+    cursor: int                        # prompt tokens already in the cache
+
+
 class ServingEngine:
     """Continuous-batching serving over a causal LM with the stacked KV
     cache (``decode_step`` + ``init_kv_cache`` layout; plain or
@@ -143,13 +186,26 @@ class ServingEngine:
                  paged: Optional[bool] = None,
                  block_len: Optional[int] = None,
                  num_blocks: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 chunked: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
+                 chunk_policy: Optional[str] = None):
         """``paged`` (default FLAGS_serving_paged_kv) selects the paged
         block-pool cache; ``block_len`` (FLAGS_kv_cache_block_len) and
         ``num_blocks`` (FLAGS_kv_cache_num_blocks; 0 derives the
         contiguous cache's footprint, num_slots·max_length/block_len,
         plus the null block) size it; ``prefix_cache``
-        (FLAGS_serving_prefix_cache) toggles prompt-prefix sharing."""
+        (FLAGS_serving_prefix_cache) toggles prompt-prefix sharing.
+
+        ``chunked`` (default FLAGS_serving_chunked_prefill) selects
+        chunked-prefill admission: prompts are split into
+        ``prefill_chunk``-token chunks (FLAGS_serving_prefill_chunk)
+        folded into the ONE mixed decode step, so a long prompt never
+        stalls in-flight decodes for a whole-prompt prefill;
+        ``chunk_policy`` (FLAGS_serving_chunk_policy): 'prefill' runs a
+        pending chunk every tick, 'decode' interleaves chunks with
+        chunk-free ticks while decodes are active (TPOT protection at
+        half the prompt-ingest rate)."""
         if hasattr(model, "init_decode_state"):
             raise NotImplementedError(
                 "ServingEngine requires the stacked KV cache; recurrent "
@@ -168,6 +224,19 @@ class ServingEngine:
         self.prefill_batch = int(prefill_batch)
         self.paged = bool(_flags.flag("serving_paged_kv")
                           if paged is None else paged)
+        self.chunked = bool(_flags.flag("serving_chunked_prefill")
+                            if chunked is None else chunked)
+        self.prefill_chunk = int(prefill_chunk
+                                 or _flags.flag("serving_prefill_chunk"))
+        self._chunk_policy = str(chunk_policy
+                                 or _flags.flag("serving_chunk_policy"))
+        if self._chunk_policy not in ("prefill", "decode"):
+            raise ValueError(
+                f"chunk_policy must be 'prefill' or 'decode', got "
+                f"{self._chunk_policy!r}")
+        if self.chunked and self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
         self._init_metrics()
 
         # quantized-decode hooks, exactly as models/generation.py binds
@@ -215,6 +284,7 @@ class ServingEngine:
         self._topp = np.ones((s,), np.float32)
 
         self._slots: List[Optional[_Slot]] = [None] * s
+        self._prefill: Optional[_Prefill] = None   # chunked-mode cursor
         self._queue: Deque[Request] = deque()
         self._results: Dict[int, List[int]] = {}
         self._next_rid = 0
@@ -228,7 +298,18 @@ class ServingEngine:
         # moment a retrace happens instead of asserted after the fact.
         # ``step_traces``/``prefill_traces`` read through to the counters.
         lbl = {"engine": self._eid}
-        if self.paged:
+        if self.chunked:
+            # chunked mode: ONE program serves every tick — num_slots
+            # decode rows plus one (possibly empty) prompt chunk, chunk
+            # size static.  The budget of 1 IS the token-budget
+            # scheduler's contract: admission, chunk progress and
+            # retirement all move through traced inputs.
+            self._step_fn = _obs.track_retraces(
+                self._mixed_step_impl_paged if self.paged
+                else self._mixed_step_impl,
+                "serving.step", budget=1, labels=lbl)
+            self._prefill_fn = None
+        elif self.paged:
             self._step_fn = _obs.track_retraces(
                 self._step_impl_paged, "serving.step", budget=1, labels=lbl)
             self._prefill_fn = _obs.track_retraces(
@@ -302,6 +383,20 @@ class ServingEngine:
             "serving.prefill_tokens_total",
             "prompt tokens submitted across admitted requests").labels(
                 **lbl)
+        self._m_chunks = ctr(
+            "serving.prefill_chunks",
+            "prompt chunks folded into mixed steps (chunked "
+            "admission)").labels(**lbl)
+        self._m_chunk_tokens = ctr(
+            "serving.prefill_chunk_tokens",
+            "real prompt tokens carried by mixed-step chunks (chunk "
+            "padding excluded)").labels(**lbl)
+        self._m_chunk_queue = hist(
+            "serving.chunk_queue_depth",
+            "pending prefill chunks at each scheduler tick: the active "
+            "prompt's remaining chunks plus every queued prompt's",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)).labels(
+                **lbl)
         self._m_step_traces = ctr(
             "jit.traces", "").labels(site="serving.step", **lbl)
         self._m_prefill_traces = ctr(
@@ -371,6 +466,74 @@ class ServingEngine:
         tok = sample_tokens(last, key, temps, topk, topp)
         return tok, cache
 
+    def _mixed_step_impl(self, params, cache, tokens, positions, slot_mask,
+                         temps, topk, topp, cids, cpos, clen, cslot,
+                         ctemp, ctopk, ctopp, key):
+        """One MIXED step (chunked mode, contiguous cache): the decode
+        rows advance one token each AND one prompt chunk streams into its
+        slot's cache row — a single program, compiled exactly once, whose
+        token budget is ``num_slots + prefill_chunk`` every tick.
+
+        Decode part: identical math to ``_step_impl``, but the host
+        steers every NON-decoding row's position to ``max_length`` so its
+        K/V scatter drops out of bounds instead of clobbering a row that
+        chunked prefill is mid-way through writing (the wave engine could
+        write junk at position 0 of idle rows because wave prefill
+        rebuilt the whole row afterwards; chunked prefill builds the row
+        incrementally, so idle writes must be dropped, not absorbed).
+
+        Chunk part: decode-at-depth of ``cids`` (one (1, chunk) row,
+        chunk size static) over the ``cslot`` cache row pulled out with a
+        dynamic slice and scattered back — per-row positions
+        ``cpos..cpos+chunk-1``, so pad-tail writes past the prompt land
+        at positions decode will overwrite before the mask can read them
+        (the wave-prefill padding argument), and a chunk-free tick rides
+        the same program with ``cpos = max_length`` (every write drops,
+        the row round-trips bit-identical).  The sampled ``ctok`` is the
+        request's FIRST token when this chunk completes the prompt; the
+        host discards it otherwise."""
+        prep = self._prepare(params)
+        with bind_params(self._bind, prep):
+            logits, cache = self.model.decode_step(
+                tokens[:, None], cache, positions)
+        nxt = sample_tokens(logits[:, -1], key, temps, topk, topp)
+        nxt = jnp.where(slot_mask, nxt, jnp.int32(self.pad_token_id))
+        row = jax.lax.dynamic_slice_in_dim(cache, cslot, 1, axis=2)
+        with bind_params(self._bind, prep):
+            clogits, row = self.model.decode_step(
+                cids, row, cpos[None])          # (1,) per-row position
+        ctok = sample_tokens(clogits[0, clen - 1][None],
+                             jax.random.fold_in(key, 1),
+                             ctemp, ctopk, ctopp)[0]
+        z = jnp.int32(0)
+        cache = jax.lax.dynamic_update_slice(cache, row,
+                                             (z, z, cslot, z, z, z))
+        return nxt, ctok, cache
+
+    def _mixed_step_impl_paged(self, params, cache, tokens, positions,
+                               tables, slot_mask, temps, topk, topp,
+                               cids, cpos, clen, ctable,
+                               ctemp, ctopk, ctopp, key):
+        """Paged twin of ``_mixed_step_impl``: the chunk writes scatter
+        straight into the slot's blocks through its own (1, max_blocks)
+        table row (the decode part sees the prefilling slot as an
+        all-null-table row, so its idle write lands in the null block),
+        and a chunk-free tick passes the all-null table itself.  No
+        row slicing — the pool IS the cache for both parts."""
+        prep = self._prepare(params)
+        with bind_params(self._bind, prep):
+            logits, cache = self.model.decode_step(
+                tokens[:, None], cache, positions, block_tables=tables)
+        nxt = sample_tokens(logits[:, -1], key, temps, topk, topp)
+        nxt = jnp.where(slot_mask, nxt, jnp.int32(self.pad_token_id))
+        with bind_params(self._bind, prep):
+            clogits, cache = self.model.decode_step(
+                cids, cache, cpos[None], block_tables=ctable)
+        ctok = sample_tokens(clogits[0, clen - 1][None],
+                             jax.random.fold_in(key, 1),
+                             ctemp, ctopk, ctopp)[0]
+        return nxt, ctok, cache
+
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt: Sequence[int],
@@ -412,10 +575,13 @@ class ServingEngine:
         Idle ticks (no queued work, no active slots — the poll loop of a
         server waiting for traffic) return immediately: no admission
         scan, no device dispatch of a fully-masked decode step."""
-        if not self._queue and not self._active.any():
+        if (not self._queue and not self._active.any()
+                and self._prefill is None):
             self._set_occupancy(0)
             return []
         with self._tracer.span("serving.step", tick=self._ticks):
+            if self.chunked:
+                return self._step_inner_chunked()
             return self._step_inner()
 
     def _step_inner(self) -> List[int]:
@@ -460,6 +626,12 @@ class ServingEngine:
             nxt = np.asarray(nxt)        # the tick's one host sync
         now = time.perf_counter()
         self._m_step_ms.observe((now - t0) * 1e3)
+        finished.extend(self._advance_decode(nxt, now))
+        return finished
+
+    def _advance_decode(self, nxt: np.ndarray, now: float) -> List[int]:
+        """Per-slot bookkeeping after a decode/mixed step's token fetch."""
+        finished: List[int] = []
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -475,12 +647,193 @@ class ServingEngine:
                 self._retire(slot, i, reason, now)
         return finished
 
+    # -- chunked-prefill scheduler (mixed steps) ---------------------------
+
+    def _step_inner_chunked(self) -> List[int]:
+        """One token-budget tick: admit the FIFO head into a free slot
+        (no prefill dispatched yet — just a cursor), then run ONE mixed
+        step carrying every decode row plus at most one
+        ``prefill_chunk``-token slice of the admitted prompt.  A long
+        prompt therefore costs a bounded latency bump per tick instead
+        of stalling every in-flight decode for its whole prefill."""
+        finished = self._admit_chunked()
+        occ = int(self._active.sum())
+        self._set_occupancy(occ)
+        pf = self._prefill
+        self._m_chunk_queue.observe(self._pending_chunks())
+        # decode-priority policy: while decodes are active, pending
+        # chunks run on alternate ticks only (odd _ticks), halving the
+        # prompt-ingest rate to shave the mixed-step TPOT bump
+        do_chunk = pf is not None and (
+            self._chunk_policy == "prefill" or occ == 0
+            or self._ticks % 2 == 1)
+        if not occ and not do_chunk:
+            return finished
+        self._ticks += 1
+        key = jax.random.fold_in(self._base_key, self._ticks)
+        ch = self.prefill_chunk
+        cids = np.full((1, ch), self.pad_token_id, np.int32)
+        ctemp = np.zeros((1,), np.float32)
+        ctopk = np.zeros((1,), np.int32)
+        ctopp = np.ones((1,), np.float32)
+        if do_chunk:
+            clen = min(ch, pf.req.prompt.size - pf.cursor)
+            cids[0, :clen] = pf.req.prompt[pf.cursor:pf.cursor + clen]
+            cpos, cslot = pf.cursor, pf.slot
+            sp = pf.req.sampling
+            ctemp[0], ctopk[0], ctopp[0] = (sp.temperature, sp.top_k,
+                                            sp.top_p)
+        else:
+            # chunk-free tick, same compiled program: contiguous writes
+            # drop past max_length, paged writes land in the null block
+            clen, cslot = 1, 0
+            cpos = 0 if self.paged else self.max_length
+        t0 = time.perf_counter()
+        chunk_span = (self._tracer.span("serving.chunk", slot=cslot,
+                                        start=cpos, tokens=clen)
+                      if do_chunk else contextlib.nullcontext())
+        with self._tracer.span("serving.decode", slots=occ), chunk_span:
+            if self.paged:
+                for i, slot in enumerate(self._slots):
+                    if slot is None:
+                        continue
+                    pos = int(self._positions[i])
+                    grew = self.kv.ensure_capacity(i, pos)
+                    cow = self.kv.ensure_writable(i, pos // self.block_len)
+                    if cow is not None:
+                        self._cache = self._cow_fn(self._cache,
+                                                   jnp.int32(cow[0]),
+                                                   jnp.int32(cow[1]))
+                    if grew or cow is not None:
+                        self._tables[i] = self.kv.table_row(
+                            i, self.max_blocks)
+                if do_chunk:
+                    # grow the chain to cover this chunk's real tokens;
+                    # pad-tail positions fall past the chain and steer to
+                    # the null block (the admission reservation makes the
+                    # growth infallible)
+                    self.kv.ensure_capacity(cslot, cpos + clen - 1)
+                    ctable = self.kv.table_row(cslot,
+                                               self.max_blocks)[None]
+                else:
+                    ctable = np.zeros((1, self.max_blocks), np.int32)
+                nxt, ctok, self._cache = self._step_fn(
+                    self._params, self._cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                    jnp.asarray(self._tables), jnp.asarray(self._active),
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp),
+                    jnp.asarray(cids), jnp.int32(cpos), jnp.int32(clen),
+                    jnp.asarray(ctable), jnp.asarray(ctemp),
+                    jnp.asarray(ctopk), jnp.asarray(ctopp), key)
+            else:
+                # non-decoding rows (idle or mid-prefill) write at
+                # max_length so the scatter drops them — chunked prefill
+                # owns those rows' contents now
+                dev_pos = np.where(self._active, self._positions,
+                                   self.max_length).astype(np.int32)
+                nxt, ctok, self._cache = self._step_fn(
+                    self._params, self._cache,
+                    jnp.asarray(self._tokens), jnp.asarray(dev_pos),
+                    jnp.asarray(self._active), jnp.asarray(self._temps),
+                    jnp.asarray(self._topk), jnp.asarray(self._topp),
+                    jnp.asarray(cids), jnp.int32(cpos), jnp.int32(clen),
+                    jnp.int32(cslot), jnp.asarray(ctemp),
+                    jnp.asarray(ctopk), jnp.asarray(ctopp), key)
+            nxt, ctok = jax.device_get((nxt, ctok))  # the tick's one sync
+        now = time.perf_counter()
+        self._m_step_ms.observe((now - t0) * 1e3)
+        finished.extend(self._advance_decode(np.asarray(nxt), now))
+        if do_chunk:
+            finished.extend(self._advance_chunk(pf, clen, int(ctok), now))
+        return finished
+
+    def _admit_chunked(self) -> List[int]:
+        """Move the FIFO head into a free slot as a partially-prefilled
+        request — a cursor, not a prefill dispatch.  One prompt streams
+        at a time (FIFO order; the chunk operand is single-slot by
+        construction).  Queue-wait is recorded ONCE here — a request
+        admitted at tick t waits zero extra queue time for its chunks."""
+        if (self._prefill is not None or not self._queue):
+            return []
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return []
+        req = self._queue[0]
+        si = free[0]
+        m = 0
+        if self.paged:
+            got = self.kv.admit(si, req.prompt, req.prompt.size,
+                                req.max_new_tokens, chunked=True)
+            if got is None:          # pool full: wait for retirements
+                self._m_blocked.inc()
+                self._tracer.instant("serving.admission_blocked",
+                                     rid=req.request_id)
+                return []
+            m = got                  # adopted prefix tokens skip compute
+        self._queue.popleft()
+        self._m_queue_wait.observe(
+            (time.perf_counter() - req.t_submit) * 1e3)
+        self._m_prefill_total.inc(int(req.prompt.size))
+        self._prefill = _Prefill(req, si, int(m))
+        return []
+
+    def _advance_chunk(self, pf: _Prefill, clen: int, ctok: int,
+                       now: float) -> List[int]:
+        """Account one ingested chunk; when it completes the prompt, the
+        sampled ``ctok`` is the request's first token and the slot flips
+        from prefilling to decoding."""
+        pf.cursor += clen
+        self._m_chunks.inc()
+        self._m_chunk_tokens.inc(clen)
+        self._m_prefill_computed.inc(clen)
+        if self.paged:
+            # register the now-written full blocks for prefix sharing —
+            # never earlier: an unwritten block must not satisfy a lookup
+            self.kv.register_prompt_upto(pf.slot, pf.req.prompt, pf.cursor)
+        plen = int(pf.req.prompt.size)
+        if pf.cursor < plen:
+            return []
+        si, req = pf.slot, pf.req
+        self._prefill = None
+        slot = _Slot(req.request_id, req.max_new_tokens - 1, t_first=now)
+        self._slots[si] = slot
+        self._active[si] = True
+        self._tokens[si] = ctok
+        self._positions[si] = plen
+        self._temps[si] = req.sampling.temperature
+        self._topk[si] = req.sampling.top_k
+        self._topp[si] = req.sampling.top_p
+        if self.paged:
+            self._tables[si] = self.kv.table_row(si, self.max_blocks)
+        self._results[req.request_id].append(ctok)
+        self._m_tokens.inc()
+        self._m_ttft.observe((now - req.t_submit) * 1e3)
+        reason = self._finish_reason(ctok, slot, si)
+        if reason is not None:
+            self._retire(slot, si, reason, now)
+            return [req.request_id]
+        return []
+
+    def _pending_chunks(self) -> int:
+        """Chunks still to ingest: the active prompt's remainder plus
+        every queued prompt's worth (the chunk-queue depth histogram)."""
+        ch = self.prefill_chunk
+        n = 0
+        if self._prefill is not None:
+            n += -(-(self._prefill.req.prompt.size
+                     - self._prefill.cursor) // ch)
+        for req in self._queue:
+            n += -(-req.prompt.size // ch)
+        return n
+
     def drain(self) -> List[Tuple[int, List[int]]]:
         """Run ticks until every submitted request completes; returns
         ``[(request_id, generated_tokens)]`` in arrival order (outputs end
         at EOS inclusive — no pad tail, unlike the fixed-shape
         ``generate()`` rows)."""
-        while self._queue or any(s is not None for s in self._slots):
+        while (self._queue or self._prefill is not None
+               or any(s is not None for s in self._slots)):
             self.step()
         return [(rid, list(toks))
                 for rid, toks in sorted(self._results.items())]
@@ -496,6 +849,13 @@ class ServingEngine:
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    @property
+    def num_pending(self) -> int:
+        """Requests admitted but still prefilling (chunked mode: the
+        prompt whose chunks are streaming in; wave mode: always 0 —
+        admission prefills in the same tick)."""
+        return int(self._prefill is not None)
 
     # -- telemetry (registry read-throughs + snapshot) ---------------------
 
@@ -552,6 +912,13 @@ class ServingEngine:
                "prefill_waves": int(self._m_waves.value()),
                "step_traces": self.step_traces,
                "prefill_traces": self.prefill_traces}
+        if self.chunked:
+            out["chunked"] = {
+                "prefill_chunk": self.prefill_chunk,
+                "chunk_policy": self._chunk_policy,
+                "prefill_chunks": int(self._m_chunks.value()),
+                "prefill_chunk_tokens": int(self._m_chunk_tokens.value()),
+                "chunk_queue_depth": hist(self._m_chunk_queue)}
         if self.paged:
             st = self.kv.stats
             total = self.prefill_tokens_total

@@ -250,7 +250,7 @@ class BlockManager:
     # -- admission ---------------------------------------------------------
 
     def admit(self, slot: int, prompt: Sequence[int], prompt_len: int,
-              max_new_tokens: int) -> Optional[int]:
+              max_new_tokens: int, chunked: bool = False) -> Optional[int]:
         """Admit a request into ``slot``: match the prompt against the
         prefix trie, reserve every block the request could need, allocate
         the blocks covering positions ``[0, prompt_len]`` now, and
@@ -261,6 +261,17 @@ class BlockManager:
         cannot cover the request yet (caller keeps it queued).  The match
         is capped at ``(prompt_len - 1) // block_len`` blocks so at least
         one token remains to produce the first sampled logits.
+
+        ``chunked``: the chunked-prefill admission contract — the prompt
+        will be written chunk by chunk over several ticks, so (a) no
+        blocks beyond the adopted prefix are allocated now (the engine
+        grows the chain per chunk via :meth:`ensure_capacity` — the
+        reservation still covers the worst case, so growth cannot fail)
+        and (b) the prompt is NOT registered in the trie yet: a block
+        must never satisfy a prefix lookup before its contents are
+        written (wave admission writes in the same scheduler call, so it
+        registers immediately; chunked callers register incrementally
+        via :meth:`register_prompt_upto` as chunks land on the device).
         """
         if slot in self._slots:
             raise ValueError(f"slot {slot} already has an allocation")
@@ -292,16 +303,32 @@ class BlockManager:
         st = _SlotAlloc(list(matched), need)
         self._slots[slot] = st
         self._reserved += need
-        # blocks covering positions [0, prompt_len]: the prefill writes the
-        # suffix and the first decode step writes position prompt_len
-        for _ in range(prompt_len // bl + 1 - m):
-            self._append_block(st)
-        if self.prefix_cache:
-            self._register_prompt(st.chain, prompt, prompt_len)
+        if not chunked:
+            # blocks covering positions [0, prompt_len]: the prefill
+            # writes the suffix and the first decode step writes position
+            # prompt_len
+            for _ in range(prompt_len // bl + 1 - m):
+                self._append_block(st)
+            if self.prefix_cache:
+                self._register_prompt(st.chain, prompt, prompt_len)
         self._counters["prefix_hit_blocks"].inc(m)
         self._counters["prefix_hit_tokens"].inc(m * bl)
         self._note_peak()
         return m * bl
+
+    def register_prompt_upto(self, slot: int, prompt: Sequence[int],
+                             upto: int):
+        """Chunked-prefill trie registration: insert the prompt's full
+        blocks whose every token is among the first ``upto`` WRITTEN
+        tokens.  Idempotent — the engine calls it after each chunk's
+        device step is dispatched (program order sequences any adopter's
+        reads after the writes), so prefix hits become available chunk by
+        chunk instead of all-or-nothing at retirement."""
+        if not self.prefix_cache:
+            return
+        st = self._slots[slot]
+        self._register_prompt(st.chain,
+                              [int(t) for t in prompt[:upto]], int(upto))
 
     def _register_prompt(self, chain: List[int], prompt: List[int],
                          prompt_len: int):

@@ -36,6 +36,11 @@ CASES = [
     (2, 1, 8, 2, 64, 256, None),      # per-row positions
     (2, 3, 8, 2, 64, 256, None),      # per-row, s>1 (prefill-into-slot)
     (3, 2, 4, 4, 16, 256, None),      # per-row, s>1, g=1
+    # chunked-prefill shapes: s·G > 64 rows — the q-tiled grid walk
+    (1, 96, 8, 2, 64, 384, 13),       # 6 q tiles of 16 tokens (g=4)
+    (2, 40, 4, 4, 32, 256, None),     # ragged last tile (40 = 2·16 + 8)
+    (1, 17, 8, 2, 64, 256, 100),      # rows 68: barely past one tile
+    (2, 33, 8, 8, 32, 256, None),     # g=1, bq=64, ragged
 ]
 
 
@@ -89,9 +94,32 @@ def test_shape_ineligibility_raises():
     q, k, v = _qkv(1, 1, 8, 2, 64, 200, seed=13)   # 200 has no 128-divisor
     with pytest.raises(NotImplementedError, match="128-aligned"):
         decode_attention_pallas(q, k, v, 5, interpret=True)
-    q, k, v = _qkv(1, 17, 8, 2, 64, 256, seed=13)  # s*G = 68 > 64 rows
-    with pytest.raises(NotImplementedError, match="prefill-shaped"):
+    # s*G > 64 no longer raises — it q-tiles (chunked prefill); the
+    # remaining q-side limits are the whole-prefill length and the
+    # per-tile GQA group size
+    q, k, v = _qkv(1, 2049, 8, 2, 64, 4096, seed=13)
+    with pytest.raises(NotImplementedError, match="whole-prefill-shaped"):
+        decode_attention_pallas(q, k, v, 0, interpret=True)
+    q, k, v = _qkv(1, 1, 128, 1, 32, 256, seed=13)  # G = 128 > 64
+    with pytest.raises(NotImplementedError, match="GQA group size"):
         decode_attention_pallas(q, k, v, 5, interpret=True)
+
+
+def test_chunked_prefill_counts_kernel_path():
+    """The q-tiled walk is the chunked-prefill kernel mode: building it
+    must count ops.kernel_path{op="chunked_prefill"} (ISSUE 5 routing
+    visibility), while q_len-1 builds keep the decode op label."""
+    from paddle_tpu import observability as obs
+
+    reg = obs.default_registry()
+    q, k, v = _qkv(1, 96, 8, 2, 64, 384, seed=3)
+    decode_attention_pallas(q, k, v, 13, block_kv=128, interpret=True)
+    fam = reg.get("ops.kernel_path")
+    assert fam is not None
+    assert fam.value(op="chunked_prefill", path="contiguous") >= 1
+    q, k, v = _qkv(1, 1, 8, 2, 64, 256, seed=3)
+    decode_attention_pallas(q, k, v, 5, block_kv=128, interpret=True)
+    assert fam.value(op="decode_attention_kernel", path="contiguous") >= 1
 
 
 # -- paged cache: block-table dereference ------------------------------------
@@ -119,6 +147,12 @@ PAGED_CASES = [
     (1, 1, 4, 4, 32, 2, [255], [[7, 2]]),      # g=1, last slot live
     (3, 2, 8, 4, 64, 4, [40, 300, 511],
      [[9, 9, 9, 9], [1, 2, 3, 4], [4, 3, 2, 1]]),  # row 0 never leaves b9
+    # chunked-prefill q over paged prefixes: s·G > 64 rows attending
+    # out-of-order / shared block tables, positions mid-block — the
+    # mixed serving step's kernel shape (ISSUE 5 oracle)
+    (2, 96, 8, 2, 64, 4, [130, 40],
+     [[5, 3, 1, 8], [5, 6, 2, 7]]),                # shared block 5
+    (1, 70, 4, 4, 32, 3, [200], [[7, 2, 4]]),      # g=1, ragged tiles
 ]
 
 
@@ -225,6 +259,15 @@ class TestDispatch:
         cached_decode_attention(q, k, v, 5)
         assert not calls
         assert decode_attention_path(1, 1, 8, 2, 64, 128)[0] == "xla_math"
+
+    def test_chunk_shape_routes_to_kernel(self):
+        """s·G > 64 is no longer prefill-shaped: a chunk-sized q over a
+        long cache routes to the kernel (q-tiled); whole-prompt q beyond
+        the chunk regime still falls back to XLA/flash territory."""
+        assert decode_attention_path(1, 96, 8, 2, 64, 256)[0] \
+            == "pallas_decode"
+        path, why = decode_attention_path(1, 4096, 8, 2, 64, 8192)
+        assert path == "xla_math" and "whole-prefill" in why
 
     def test_extra_mask_falls_back(self, monkeypatch):
         from paddle_tpu.ops.pallas import decode_attention as mod

@@ -206,6 +206,136 @@ def test_idle_step_skips_device_dispatch(lm):
     assert dict(eng.drain())[rid] == _reference(lm, p, 2)
 
 
+def _staggered_trace(eng, long_p, shorts):
+    """Two short decodes in flight, a LONG prompt arrives mid-decode,
+    two more shorts queue behind it — the head-of-line-blocking trace."""
+    rids = [eng.submit(shorts[0], max_new_tokens=10),
+            eng.submit(shorts[1], max_new_tokens=10)]
+    eng.step()
+    eng.step()
+    rids.append(eng.submit(long_p, max_new_tokens=6))
+    eng.step()
+    rids += [eng.submit(shorts[2], max_new_tokens=8),
+             eng.submit(shorts[3], max_new_tokens=8)]
+    return rids, dict(eng.drain())
+
+
+def test_chunked_engine_matches_wave_engine(lm):
+    """ISSUE 5 acceptance: the mixed-step (chunked prefill) engine's
+    greedy outputs are token-identical to the wave engine on a staggered
+    trace where a long prompt arrives while short requests are
+    mid-decode, with the mixed step compiled exactly once (the armed
+    watchdog raises on any retrace)."""
+    long_p = _prompt(40, seed=70)
+    shorts = [_prompt(n, seed=71 + i) for i, n in enumerate((5, 7, 6, 9))]
+    wave = ServingEngine(lm, num_slots=3, max_length=MAXLEN)
+    rw, outw = _staggered_trace(wave, long_p, shorts)
+    ck = ServingEngine(lm, num_slots=3, max_length=MAXLEN, chunked=True,
+                       prefill_chunk=8)
+    rc, outc = _staggered_trace(ck, long_p, shorts)
+    assert ck.step_traces == 1, (
+        f"mixed step retraced: {ck.step_traces} traces")
+    assert ck.prefill_traces == 0      # no wave-prefill programs at all
+    for a, b in zip(rw, rc):
+        assert outw[a] == outc[b], (outw[a], outc[b])
+    # the long prompt really streamed in chunks (40 tokens / 8 = 5)
+    m = ck.metrics()["chunked"]
+    assert m["prefill_chunks"] >= 5 + len(shorts)
+    assert m["chunk_queue_depth"]["count"] > 0
+    # and the long output matches greedy_generate directly too
+    assert outc[rc[2]] == _reference(lm, long_p, 6)
+
+
+def test_chunked_decode_priority_policy_parity(lm):
+    """chunk_policy='decode' (chunks interleave with chunk-free ticks)
+    changes scheduling, never tokens."""
+    long_p = _prompt(26, seed=75)
+    shorts = [_prompt(n, seed=76 + i) for i, n in enumerate((5, 7, 6, 9))]
+    wave = ServingEngine(lm, num_slots=3, max_length=MAXLEN)
+    rw, outw = _staggered_trace(wave, long_p, shorts)
+    ck = ServingEngine(lm, num_slots=3, max_length=MAXLEN, chunked=True,
+                       prefill_chunk=8, chunk_policy="decode")
+    rc, outc = _staggered_trace(ck, long_p, shorts)
+    assert ck.step_traces == 1
+    for a, b in zip(rw, rc):
+        assert outw[a] == outc[b]
+
+
+def test_chunked_single_chunk_and_eos_at_first_token(lm):
+    """A prompt shorter than the chunk budget completes in one mixed
+    step; retirement at the first token (max_new_tokens=1) works from
+    the chunk-completion path."""
+    p = _prompt(5, seed=85)
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN, chunked=True,
+                        prefill_chunk=16)
+    r0 = eng.submit(p, max_new_tokens=1)
+    r1 = eng.submit(_prompt(7, seed=86), max_new_tokens=4)
+    out = dict(eng.drain())
+    assert out[r0] == _reference(lm, p, 1)
+    assert len(out[r0]) == 1
+    assert out[r1] == _reference(lm, _prompt(7, seed=86), 4)
+
+
+def test_queue_accounting_under_chunked_admission(lm):
+    """ISSUE 5 satellite: a request queued across many ticks has its
+    queue-wait recorded ONCE at admission (not per chunk), and
+    queue_depth is correct between submit() and the first step()."""
+    eng = ServingEngine(lm, num_slots=1, max_length=MAXLEN, chunked=True,
+                        prefill_chunk=4)
+    p0, p1 = _prompt(18, seed=80), _prompt(6, seed=81)
+    r0 = eng.submit(p0, max_new_tokens=2)
+    r1 = eng.submit(p1, max_new_tokens=2)
+    # between submit() and the first step() nothing is admitted yet
+    assert eng.queue_depth == 2
+    eng.step()
+    # head admitted into the slot (prefilling); the second still queued
+    assert eng.queue_depth == 1
+    assert eng._m_queue_wait.count == 1
+    for _ in range(4):                 # 18/4 -> 5 chunks; r1 stays queued
+        eng.step()
+    assert eng._m_queue_wait.count == 1, (
+        "queue-wait re-observed per chunk")
+    out = dict(eng.drain())
+    assert eng._m_queue_wait.count == 2   # exactly once per request
+    assert out[r0] == _reference(lm, p0, 2)
+    assert out[r1] == _reference(lm, p1, 2)
+
+
+def test_queue_depth_between_submit_and_step_wave(lm):
+    """Same queue_depth contract for the wave engine (regression guard
+    for the accounting audit)."""
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN)
+    for i in range(3):
+        eng.submit(_prompt(4 + i, seed=90 + i), max_new_tokens=2)
+    assert eng.queue_depth == 3
+    eng.step()
+    assert eng.queue_depth <= 1
+    assert eng._m_queue_wait.count >= 2   # admitted requests observed once
+    eng.drain()
+    assert eng._m_queue_wait.count == 3
+
+
+def test_chunked_idle_step_skips_device_dispatch(lm):
+    """The idle-tick contract holds in chunked mode: no queue, no active
+    slot, no prefill cursor — no device dispatch."""
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN, chunked=True)
+    real = eng._step_fn
+
+    def boom(*a, **k):
+        raise AssertionError("idle tick dispatched a mixed step")
+
+    eng._step_fn = boom
+    try:
+        for _ in range(3):
+            assert eng.step() == []
+        assert eng._ticks == 0
+    finally:
+        eng._step_fn = real
+    p = _prompt(4, seed=95)
+    rid = eng.submit(p, max_new_tokens=2)
+    assert dict(eng.drain())[rid] == _reference(lm, p, 2)
+
+
 def test_per_row_position_decode_matches_scalar(lm):
     """The serving-enabling primitive: decode_step with a per-row
     position VECTOR must equal per-row scalar decode_steps."""
