@@ -760,6 +760,19 @@ def _fused_vs_stack(batch=1, prompt=8, max_len=1024, t1=8, t2=72,
             "fused_over_stack": round(per_stack / per_fused, 3)}
 
 
+def _cache_hbm_row(eng):
+    """Per-step KV-cache residency accounting (BASELINE.md graph-lint
+    conventions): resident bytes with the step's cache operand donated
+    (1x, the shipped configuration) vs the un-donated double-buffer
+    (2x) the static_analysis donation rule exists to catch."""
+    cb = int(eng.cache_hbm_bytes)
+    return {"cache_bytes": cb,
+            "per_step_resident_bytes": {"donated": cb,
+                                        "no_donation": 2 * cb},
+            "step_cache_donated": True,
+            "graph_lint_findings": len(eng.lint_step())}
+
+
 def _serving_bench(model, on_tpu):
     """Continuous-batching engine under a Poisson-ish synthetic arrival
     trace (paddle_tpu/serving): exponential inter-arrival gaps measured
@@ -818,6 +831,12 @@ def _serving_bench(model, on_tpu):
            "mean_slot_occupancy": round(float(np.mean(occ)) / slots, 3),
            "step_traces": eng.step_traces,
            "prefill_traces": eng.prefill_traces,
+           # cache HBM accounting (ISSUE 6): the once-jitted step takes
+           # and returns the full cache; its donate_argnums alias lets
+           # XLA reuse the buffer in place, so a tick keeps 1x the cache
+           # resident instead of the 2x an un-donated carry pins — the
+           # graph-lint donation rule guards the 1x
+           "cache_hbm": _cache_hbm_row(eng),
            # SLO snapshot straight from the observability registry (the
            # engine's own series; BASELINE.md conventions) — TTFT/TPOT/
            # queue-wait percentiles span BOTH passes, so the warm pass's
@@ -994,6 +1013,7 @@ def _paged_serving_bench(model, on_tpu):
             "prefill_tokens_computed_2pass": eng.prefill_tokens_computed,
             "step_traces": eng.step_traces,
             "prefill_traces": eng.prefill_traces,
+            "cache_hbm": _cache_hbm_row(eng),
             # registry snapshot: percentiles + the pool's cache
             # accounting (metrics.kv_cache.prefix_hit_rate uses admitted
             # prompt tokens as denominator, so it matches the

@@ -34,6 +34,13 @@ debugging technique made structural).
 this lint at its first call (the earliest point batch shapes exist) —
 one abstract trace, nothing per step after.  The dryrun and the pair
 tests also invoke it directly.
+
+The jaxpr plumbing this rule pioneered — sub-jaxpr discovery, the
+rename-tolerant primitive canonicalisation, the 0.4.x shard_map
+rep-rule fallbacks — now lives in :mod:`paddle_tpu.static_analysis.core`
+(ISSUE 6): this module is the shared walker's first client, alongside
+the graph-lint rules (donation / dtype / const-capture / host-sync /
+retrace-hazard) that generalized it into a static-analysis layer.
 """
 
 from __future__ import annotations
@@ -42,27 +49,22 @@ from typing import Any, List, Sequence, Tuple
 
 import jax
 
+from ..static_analysis.core import (CANONICAL as _CANONICAL,
+                                    install_rep_rule_fallbacks
+                                    as _install_rep_rule_fallbacks,
+                                    sub_jaxprs as _sub_jaxprs)
+
 __all__ = ["CollectiveOrderError", "collective_schedule",
            "check_collective_order"]
 
 # primitive names that lower to cross-replica communication.  jax renames
-# these across versions — lax.psum traces as "psum2" under the 0.4.x
-# shard_map rewrite and as "psum_invariant" under the vma type system
-# (jax >= 0.8) — so the lint matches through _CANONICAL instead of
-# pinning one release's strings.  The replication *casts* ("pbroadcast"
-# on 0.4.x, "pvary" on vma jax) move no data and are deliberately absent.
+# these across versions — the lint matches through the shared _CANONICAL
+# table (static_analysis/core.py) instead of pinning one release's
+# strings.  The replication *casts* ("pbroadcast" on 0.4.x, "pvary" on
+# vma jax) move no data and are deliberately absent.
 _COLLECTIVE_PRIMS = {
     "psum", "psum_invariant", "pmax", "pmin", "all_gather",
     "all_to_all", "ppermute", "reduce_scatter", "psum_scatter", "pgather",
-}
-
-# version-specific primitive name -> the canonical name the schedule
-# reports (and tests pin): the jax-rename-tolerant matching layer
-_CANONICAL = {
-    "psum": "psum_invariant",
-    "psum2": "psum_invariant",
-    "psum_invariant": "psum_invariant",
-    "all_gather_invariant": "all_gather",
 }
 _COLLECTIVE_PRIMS |= set(_CANONICAL)
 
@@ -84,53 +86,9 @@ def _sig(eqn) -> Tuple:
         (k, str(v)) for k, v in params.items())), shapes)
 
 
-def _install_rep_rule_fallbacks():
-    """jax 0.4.x's shard_map rep-checker has no rule for ``while`` (and
-    raises NotImplementedError at trace time), so linting a while_loop
-    under shard_map — the exact pattern this lint exists to inspect —
-    would explode before the walk even starts.  Register a conservative
-    fallback (outputs replicated over NO axes: never claims a replication
-    it can't prove, so it is sound for any out_specs that mention every
-    mesh axis) for the control-flow primitives the checker is missing.
-    vma-era jax (>= 0.8) has real rules and is left untouched."""
-    try:
-        from jax.experimental import shard_map as _sm
-        rules = getattr(_sm, "_check_rules", None)
-        if rules is None:
-            return
-        import jax.extend.core as _core  # noqa: F401  (presence probe)
-        from jax import lax as _lax
-        for prim_name in ("while_p",):
-            prim = getattr(_lax, prim_name, None)
-            if prim is None:
-                from jax._src.lax import control_flow as _cf
-                prim = getattr(_cf, prim_name, None)
-            if prim is not None and prim not in rules:
-                rules[prim] = lambda mesh, *in_rep, **params: set()
-                # the efficient-transpose rewrite trace keeps a second
-                # rule table; "bind unchanged, rep from the check rule"
-                # is the registered no-op there
-                if hasattr(_sm, "register_norewrite"):
-                    _sm.register_norewrite(prim)
-    except Exception:       # pragma: no cover - newer jax needs nothing
-        pass
-
-
+# imported for effect at this module's historical call point (idempotent;
+# static_analysis.core also installs at its own import)
 _install_rep_rule_fallbacks()
-
-
-def _sub_jaxprs(eqn):
-    """(kind, jaxpr) pairs hiding in an eqn's params (duck-typed: a
-    ClosedJaxpr exposes ``.jaxpr``, a raw Jaxpr exposes ``.eqns``)."""
-    out = []
-    for k, v in eqn.params.items():
-        vals = v if isinstance(v, (tuple, list)) else [v]
-        for item in vals:
-            if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
-                out.append((k, item.jaxpr))
-            elif hasattr(item, "eqns"):          # raw Jaxpr
-                out.append((k, item))
-    return out
 
 
 def _walk(jaxpr, path: str, schedule: List, violations: List) -> None:

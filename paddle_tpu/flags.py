@@ -185,6 +185,27 @@ DEFINE("serving_chunk_policy", "prefill",
        "prompt chunk on every tick (fastest TTFT); 'decode' interleaves "
        "— while any slot is decoding, chunks run on alternate ticks "
        "only, halving prefill bandwidth to protect TPOT further")
+# graph lint (paddle_tpu/static_analysis): jaxpr static analysis of the
+# serving hot path — donation, dtype widening, constant capture,
+# host-sync, retrace hazards — one abstract trace, before any device run
+DEFINE("graph_lint", "off",
+       "serving-engine self-lint at the first scheduler tick: 'raise' "
+       "(GraphLintError on any finding — the dedicated lint tests arm "
+       "this), 'warn' (one GraphLintWarning; the tier-1 conftest default "
+       "so every serving test lints implicitly), 'off' (no self-lint; "
+       "analyze()/check() and the CLI still work explicitly)")
+DEFINE("graph_lint_donation_min_bytes", 1 << 16,
+       "donation rule: only outputs at least this big are matched "
+       "against un-donated inputs (64 KiB default keeps (num_slots,) "
+       "token vectors out while any real KV cache is in)")
+DEFINE("graph_lint_widen_bytes", 1 << 16,
+       "dtype-promotion rule: minimum operand size for a flagged "
+       "f32/f64 widening (small scalars/stats widen for free)")
+DEFINE("graph_lint_const_bytes", 1 << 20,
+       "constant-capture rule: arrays baked into a jaxpr as consts at "
+       "least this big are findings (weights closed over instead of "
+       "passed as args cost HBM alongside the live copy and retrace on "
+       "update); tiny eps/table consts stay below it")
 # observability (paddle_tpu/observability): metrics registry + span tracer
 DEFINE("retrace_watchdog", "warn",
        "action when a track_retraces call-site compiles past its trace "

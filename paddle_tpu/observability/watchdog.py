@@ -55,12 +55,22 @@ class TrackedFunction:
     ``fn(...)`` dispatches to the (jitted) wrapped function; ``.traces``
     reads the registry counter — the number of times jax traced the
     wrapped body since this site's counter was created.
+
+    ``python_fn`` is the ORIGINAL python function (before the counting
+    hook and ``jax.jit``) and ``jit_kwargs`` the kwargs the jit was
+    built with — the graph lint (paddle_tpu/static_analysis) reads both
+    so ``analyze(tracked_fn, *args)`` traces the raw body (no watchdog
+    budget spent) while still seeing what the real call site donates.
     """
 
-    def __init__(self, fn: Callable, name: str, counter):
+    def __init__(self, fn: Callable, name: str, counter,
+                 python_fn: Optional[Callable] = None,
+                 jit_kwargs: Optional[Dict[str, Any]] = None):
         self._fn = fn
         self.name = name
         self.counter = counter
+        self.python_fn = python_fn
+        self.jit_kwargs = dict(jit_kwargs or {})
         functools.update_wrapper(self, fn, updated=())
 
     def __call__(self, *args, **kwargs):
@@ -122,4 +132,5 @@ def track_retraces(fn: Callable, name: str, budget: Optional[int] = None,
         if jit_kwargs:
             raise TypeError("jit_kwargs given but jit=False")
         wrapped = counted
-    return TrackedFunction(wrapped, name, counter)
+    return TrackedFunction(wrapped, name, counter,
+                           python_fn=fn, jit_kwargs=jit_kwargs)

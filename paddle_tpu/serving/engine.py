@@ -261,10 +261,12 @@ class ServingEngine:
             self._tables = np.zeros((self.num_slots, self.max_blocks),
                                     np.int32)
             # COW device copy (compiled once; only dispatched when a
-            # shared block is about to be written — see kv_cache.py)
+            # shared block is about to be written — see kv_cache.py).
+            # The pool is donated: the copy aliases it in place.
             self._cow_fn = _obs.track_retraces(
                 lambda c, src, dst: c.at[:, :, dst].set(c[:, :, src]),
-                "serving.cow", labels={"engine": self._eid})
+                "serving.cow", labels={"engine": self._eid},
+                donate_argnums=(0,))
         else:
             cache = init_kv_cache(model.config, self.num_slots,
                                   self.max_length)
@@ -298,6 +300,14 @@ class ServingEngine:
         # moment a retrace happens instead of asserted after the fact.
         # ``step_traces``/``prefill_traces`` read through to the counters.
         lbl = {"engine": self._eid}
+        # every step/prefill program takes the FULL cache as operand 1
+        # and returns it: donating that operand lets XLA alias the
+        # buffers in place, so a tick keeps ONE cache resident instead
+        # of double-buffering the dominant HBM consumer (the engine
+        # rebinds self._cache from the output immediately, so the
+        # donated input is never read again).  The graph-lint donation
+        # rule (paddle_tpu/static_analysis) verifies this stays true.
+        donate = {"donate_argnums": (1,)}
         if self.chunked:
             # chunked mode: ONE program serves every tick — num_slots
             # decode rows plus one (possibly empty) prompt chunk, chunk
@@ -307,20 +317,23 @@ class ServingEngine:
             self._step_fn = _obs.track_retraces(
                 self._mixed_step_impl_paged if self.paged
                 else self._mixed_step_impl,
-                "serving.step", budget=1, labels=lbl)
+                "serving.step", budget=1, labels=lbl, **donate)
             self._prefill_fn = None
         elif self.paged:
             self._step_fn = _obs.track_retraces(
-                self._step_impl_paged, "serving.step", budget=1, labels=lbl)
+                self._step_impl_paged, "serving.step", budget=1,
+                labels=lbl, **donate)
             self._prefill_fn = _obs.track_retraces(
                 self._prefill_impl_paged, "serving.prefill",
-                budget=_PREFILL_TRACE_BUDGET, labels=lbl)
+                budget=_PREFILL_TRACE_BUDGET, labels=lbl, **donate)
         else:
             self._step_fn = _obs.track_retraces(
-                self._step_impl, "serving.step", budget=1, labels=lbl)
+                self._step_impl, "serving.step", budget=1, labels=lbl,
+                **donate)
             self._prefill_fn = _obs.track_retraces(
                 self._prefill_impl, "serving.prefill",
-                budget=_PREFILL_TRACE_BUDGET, labels=lbl)
+                budget=_PREFILL_TRACE_BUDGET, labels=lbl, **donate)
+        self._linted = False           # first-tick self-lint (graph_lint)
 
     def _init_metrics(self):
         """Declare this engine's series in the shared registry (metric
@@ -579,6 +592,16 @@ class ServingEngine:
                 and self._prefill is None):
             self._set_occupancy(0)
             return []
+        if not self._linted:
+            # first real tick: self-lint the once-jitted step under
+            # FLAGS_graph_lint (one abstract trace, no compile) — the
+            # donation/dtype/const/host-sync/retrace rules fail loudly
+            # here, BEFORE the first device dispatch, when armed
+            self._linted = True
+            if _flags.flag("graph_lint") != "off":
+                from .. import static_analysis as _sa
+                _sa.enforce(self.lint_step(),
+                            context=f"serving.step engine={self._eid}")
         with self._tracer.span("serving.step", tick=self._ticks):
             if self.chunked:
                 return self._step_inner_chunked()
@@ -856,6 +879,62 @@ class ServingEngine:
         prompt whose chunks are streaming in; wave mode: always 0 —
         admission prefills in the same tick)."""
         return int(self._prefill is not None)
+
+    # -- static analysis (graph lint) --------------------------------------
+
+    def _lint_args(self) -> Tuple:
+        """Representative step-function arguments for an ABSTRACT trace:
+        zero-valued, but exactly the shapes/dtypes every real tick
+        passes (strong-typed vectors, jnp.int32 chunk scalars, a typed
+        PRNG key) — the lint sees the program the scheduler runs."""
+        s = self.num_slots
+        toks = jnp.zeros((s,), jnp.int32)
+        pos = jnp.zeros((s,), jnp.int32)
+        mask = jnp.zeros((s,), bool)
+        temps = jnp.zeros((s,), jnp.float32)
+        topk = jnp.zeros((s,), jnp.int32)
+        topp = jnp.ones((s,), jnp.float32)
+        key = jax.random.fold_in(self._base_key, 0)
+        if self.chunked:
+            cids = jnp.zeros((1, self.prefill_chunk), jnp.int32)
+            cpos, clen = jnp.int32(0), jnp.int32(1)
+            ctemp = jnp.zeros((1,), jnp.float32)
+            ctopk = jnp.zeros((1,), jnp.int32)
+            ctopp = jnp.ones((1,), jnp.float32)
+            if self.paged:
+                tables = jnp.zeros((s, self.max_blocks), jnp.int32)
+                ctable = jnp.zeros((1, self.max_blocks), jnp.int32)
+                return (self._params, self._cache, toks, pos, tables,
+                        mask, temps, topk, topp, cids, cpos, clen,
+                        ctable, ctemp, ctopk, ctopp, key)
+            return (self._params, self._cache, toks, pos, mask, temps,
+                    topk, topp, cids, cpos, clen, jnp.int32(0), ctemp,
+                    ctopk, ctopp, key)
+        if self.paged:
+            tables = jnp.zeros((s, self.max_blocks), jnp.int32)
+            return (self._params, self._cache, toks, pos, tables, mask,
+                    temps, topk, topp, key)
+        return (self._params, self._cache, toks, pos, mask, temps, topk,
+                topp, key)
+
+    def lint_step(self):
+        """Graph-lint this engine's once-jitted step function (one
+        abstract trace; the TrackedFunction's stored donate_argnums are
+        honoured).  Returns the finding list — the serving contract is
+        that it is EMPTY; ``FLAGS_graph_lint`` arms the same check at
+        the first scheduler tick."""
+        from .. import static_analysis as _sa
+        return _sa.analyze(self._step_fn, *self._lint_args())
+
+    @property
+    def cache_hbm_bytes(self) -> int:
+        """Bytes of the KV cache (contiguous rows or paged pool) this
+        engine keeps resident on device.  With the step's cache operand
+        donated, per-tick residency is 1x this; un-donated it would be
+        2x (input + output live across the call) — the graph-lint
+        donation rule's finding, and the bench rows' accounting."""
+        return int(sum(leaf.nbytes
+                       for leaf in jax.tree_util.tree_leaves(self._cache)))
 
     # -- telemetry (registry read-throughs + snapshot) ---------------------
 

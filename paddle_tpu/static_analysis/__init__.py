@@ -1,0 +1,121 @@
+"""paddle_tpu.static_analysis — jaxpr graph lint for the serving hot path.
+
+PAPER.md's sanitizer row ("XLA's checker + a shard_map collective-order
+lint of our own") shipped its first rule as the collective-order lint in
+``distributed/lint.py``; this package generalizes that one-off into a
+static-analysis LAYER: one shared jaxpr walker (:mod:`.core` — the
+collective lint is its first client) plus pluggable rules
+(:mod:`.rules`) producing structured :class:`Finding`\\ s, each a class
+of silent perf/memory bug that ONE abstract trace catches before any
+device run:
+
+  * **donation** (error) — jitted outputs whose aval matches a
+    non-donated input: the serving step threads the full KV cache, so a
+    missed ``donate_argnums`` double-buffers the dominant HBM consumer;
+  * **dtype-promotion** (warning) — f32/f64 widenings of large
+    low-precision operands (allowlist for softmax/norm accumulators);
+  * **constant-capture** (error) — big arrays baked into the jaxpr as
+    consts (weights closed over ⇒ HBM bloat + retrace on update);
+  * **host-sync** (error) — ``pure_callback``/``io_callback``/
+    ``debug_callback``/infeed/outfeed inside a step (would serialize the
+    tick loop; observability hooks are allowlisted);
+  * **retrace-hazard** (warning) — weak-typed scalar leaks and
+    non-canonical dtypes in the call signature, the before-the-fact
+    complement of the retrace watchdog's budget.
+
+API mirrors the collective lint: :func:`analyze` returns findings,
+:func:`check` raises :class:`GraphLintError` on any.  ``FLAGS_graph_lint``
+(off/warn/raise) arms the serving engines' self-lint — every
+``ServingEngine`` lints its own once-jitted step at the first tick —
+and ``python -m paddle_tpu.static_analysis`` lints a tiny-config engine
+step in every cache layout and prints the report.
+
+A lint pass is ONE ``jax.make_jaxpr`` trace: abstract, no compile, no
+device dispatch.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional, Sequence
+
+from .. import flags as _flags
+from . import core, rules
+from .core import (Finding, GraphLintError, GraphLintWarning,
+                   LintContext, trace_for_lint)
+from .rules import (ConstantCaptureRule, DonationRule, DtypePromotionRule,
+                    HostSyncRule, RetraceHazardRule, Rule, default_rules)
+
+__all__ = [
+    "Finding", "GraphLintError", "GraphLintWarning", "LintContext",
+    "Rule", "DonationRule", "DtypePromotionRule", "ConstantCaptureRule",
+    "HostSyncRule", "RetraceHazardRule", "default_rules",
+    "analyze", "check", "enforce", "report", "trace_for_lint",
+]
+
+
+def analyze(fn, *args, donate_argnums=None, donate_argnames=None,
+            rules: Optional[Sequence[Rule]] = None,
+            **kwargs) -> List[Finding]:
+    """Trace ``fn`` abstractly and run the graph-lint rules; returns
+    findings (errors first) without raising.
+
+    ``fn`` must be a PYTHON function (pre-jit).  A ``track_retraces``
+    wrapper (observability/watchdog.py) is unwrapped automatically: its
+    stored ``python_fn`` is traced — never the counted body, so a lint
+    pass costs no watchdog budget — and its ``jit_kwargs`` supply
+    ``donate_argnums``/``donate_argnames`` unless given explicitly, so
+    ``analyze(engine._step_fn, *args)`` sees exactly what the real call
+    site donates."""
+    raw = getattr(fn, "python_fn", None)
+    if raw is not None:                          # TrackedFunction
+        jk = dict(getattr(fn, "jit_kwargs", None) or {})
+        if donate_argnums is None:
+            donate_argnums = jk.get("donate_argnums", ())
+        if donate_argnames is None:
+            donate_argnames = jk.get("donate_argnames", ())
+        fn = raw
+    ctx = trace_for_lint(fn, *args,
+                         donate_argnums=donate_argnums or (),
+                         donate_argnames=donate_argnames or (), **kwargs)
+    findings: List[Finding] = []
+    for rule in (rules if rules is not None else default_rules()):
+        findings.extend(rule.run(ctx))
+    order = {"error": 0, "warning": 1}
+    findings.sort(key=lambda f: order.get(f.severity, 2))
+    return findings
+
+
+def report(findings: Sequence[Finding], context: str = "") -> str:
+    """Human-readable multi-line report of a finding list."""
+    head = (f"graph lint: {len(findings)} finding(s)"
+            + (f" in {context}" if context else ""))
+    return "\n".join([head] + [f"  {f}" for f in findings])
+
+
+def check(fn, *args, **kwargs) -> List[Finding]:
+    """Lint ``fn``; raise :class:`GraphLintError` on ANY finding, else
+    return the (empty) finding list — the collective lint's
+    ``check_collective_order`` contract."""
+    findings = analyze(fn, *args, **kwargs)
+    if findings:
+        raise GraphLintError(report(findings))
+    return findings
+
+
+def enforce(findings: Sequence[Finding],
+            context: str = "") -> Sequence[Finding]:
+    """Apply ``FLAGS_graph_lint`` to a finding list: ``raise`` →
+    :class:`GraphLintError`, ``warn`` → one :class:`GraphLintWarning`,
+    ``off`` → pass through.  Serving engines call this on their
+    first-tick self-lint."""
+    if not findings:
+        return findings
+    action = str(_flags.flag("graph_lint"))
+    if action == "off":
+        return findings
+    msg = report(findings, context)
+    if action == "raise":
+        raise GraphLintError(msg)
+    warnings.warn(msg, GraphLintWarning, stacklevel=2)
+    return findings

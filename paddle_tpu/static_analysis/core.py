@@ -1,0 +1,259 @@
+"""Shared jaxpr-walking core for paddle_tpu's static analyzers.
+
+PAPER.md's sanitizer row names the TPU-native answer to upstream
+Paddle's NCCL watchdog + StreamSafeCUDAAllocator as "XLA's checker + a
+shard_map collective-order lint of our own".  The collective lint
+(distributed/lint.py) was the first such rule; this module is the
+machinery it and every later rule share, factored out so there is ONE
+version-compat surface for jax's primitive renames, ONE sub-jaxpr
+discovery convention, and ONE structured :class:`Finding` shape:
+
+  * :func:`sub_jaxprs` / :func:`iter_eqns` — duck-typed discovery and
+    recursive walking of the jaxprs hiding in eqn params (pjit bodies,
+    scan/cond/while branches, shard_map, remat, custom_* rules);
+  * :data:`CANONICAL` / :func:`canonical_name` — the jax-rename-tolerant
+    primitive-name mapping (``psum``/``psum2``/``psum_invariant`` are one
+    collective across jax releases);
+  * :func:`install_rep_rule_fallbacks` — the 0.4.x shard_map rep-checker
+    shims without which linting a while_loop under shard_map explodes
+    before any walk starts;
+  * :func:`trace_for_lint` — one abstract trace of a python function
+    into a :class:`LintContext` (closed jaxpr + flat labelled inputs +
+    donation marks), the input every graph-lint rule consumes.
+
+Nothing here runs device code: ``jax.make_jaxpr`` is abstract, so a lint
+pass costs one trace, before any compile or dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+
+__all__ = ["Finding", "GraphLintError", "GraphLintWarning", "CANONICAL",
+           "canonical_name", "sub_jaxprs", "iter_eqns", "aval_bytes",
+           "install_rep_rule_fallbacks", "FlatInput", "LintContext",
+           "trace_for_lint"]
+
+
+class GraphLintError(RuntimeError):
+    """Static-analysis findings promoted to an error (``check`` /
+    ``enforce`` under ``FLAGS_graph_lint='raise'``)."""
+
+
+class GraphLintWarning(UserWarning):
+    """Findings surfaced under ``FLAGS_graph_lint='warn'``."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured lint finding.
+
+    ``rule``: the rule id (``donation``, ``dtype-promotion``, ...);
+    ``severity``: ``error`` (a perf/memory bug on the serving hot path)
+    or ``warning`` (a hazard worth a look); ``path``: the eqn path
+    through the jaxpr (``""`` = the traced function's top level /
+    its input-output signature); ``bytes``: estimated HBM at stake,
+    where the rule can size it.
+    """
+
+    rule: str
+    severity: str
+    path: str
+    message: str
+    bytes: Optional[int] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"rule": self.rule, "severity": self.severity,
+                             "path": self.path, "message": self.message}
+        if self.bytes is not None:
+            d["bytes"] = int(self.bytes)
+        return d
+
+    def __str__(self) -> str:
+        b = f" [{self.bytes} bytes]" if self.bytes is not None else ""
+        return (f"{self.rule}({self.severity}) "
+                f"{self.path or '<signature>'}: {self.message}{b}")
+
+
+# version-specific primitive name -> the canonical name schedules report
+# (and tests pin): jax renames collectives across releases — lax.psum
+# traces as "psum2" under the 0.4.x shard_map rewrite and as
+# "psum_invariant" under the vma type system (jax >= 0.8) — so analyzers
+# match through this table instead of pinning one release's strings.
+CANONICAL: Dict[str, str] = {
+    "psum": "psum_invariant",
+    "psum2": "psum_invariant",
+    "psum_invariant": "psum_invariant",
+    "all_gather_invariant": "all_gather",
+}
+
+
+def canonical_name(name: str) -> str:
+    """Canonical primitive name across jax releases."""
+    return CANONICAL.get(name, name)
+
+
+def install_rep_rule_fallbacks() -> None:
+    """jax 0.4.x's shard_map rep-checker has no rule for ``while`` (and
+    raises NotImplementedError at trace time), so linting a while_loop
+    under shard_map — the exact pattern the collective lint exists to
+    inspect — would explode before the walk even starts.  Register a
+    conservative fallback (outputs replicated over NO axes: never claims
+    a replication it can't prove, so it is sound for any out_specs that
+    mention every mesh axis) for the control-flow primitives the checker
+    is missing.  vma-era jax (>= 0.8) has real rules and is left
+    untouched.  Idempotent."""
+    try:
+        from jax.experimental import shard_map as _sm
+        rules = getattr(_sm, "_check_rules", None)
+        if rules is None:
+            return
+        import jax.extend.core as _core  # noqa: F401  (presence probe)
+        from jax import lax as _lax
+        for prim_name in ("while_p",):
+            prim = getattr(_lax, prim_name, None)
+            if prim is None:
+                from jax._src.lax import control_flow as _cf
+                prim = getattr(_cf, prim_name, None)
+            if prim is not None and prim not in rules:
+                rules[prim] = lambda mesh, *in_rep, **params: set()
+                # the efficient-transpose rewrite trace keeps a second
+                # rule table; "bind unchanged, rep from the check rule"
+                # is the registered no-op there
+                if hasattr(_sm, "register_norewrite"):
+                    _sm.register_norewrite(prim)
+    except Exception:       # pragma: no cover - newer jax needs nothing
+        pass
+
+
+install_rep_rule_fallbacks()
+
+
+def sub_jaxprs(eqn) -> List[Tuple[str, Any]]:
+    """(param_name, jaxpr) pairs hiding in an eqn's params (duck-typed: a
+    ClosedJaxpr exposes ``.jaxpr``, a raw Jaxpr exposes ``.eqns``)."""
+    out = []
+    for k, v in eqn.params.items():
+        vals = v if isinstance(v, (tuple, list)) else [v]
+        for item in vals:
+            if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                out.append((k, item.jaxpr))
+            elif hasattr(item, "eqns"):          # raw Jaxpr
+                out.append((k, item))
+    return out
+
+
+def iter_eqns(jaxpr, path: str = "") -> Iterator[Tuple[str, Any]]:
+    """Yield ``(path, eqn)`` for every equation reachable from ``jaxpr``,
+    descending into sub-jaxprs (pjit bodies, scan/cond/while branches,
+    shard_map, remat, custom_* rules).  Path components are primitive
+    names; primitives carrying a string ``name`` param (pjit, remat)
+    append it as ``pjit[softmax]`` so rules can allowlist regions by the
+    traced function's own name."""
+    for eqn in jaxpr.eqns:
+        yield path, eqn
+        name = eqn.primitive.name
+        tag = eqn.params.get("name")
+        comp = f"{name}[{tag}]" if isinstance(tag, str) else name
+        for _, sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub, f"{path}/{comp}")
+
+
+def aval_bytes(aval) -> Optional[int]:
+    """Byte size of an abstract value, or None when it has no static
+    numeric size (extended dtypes like PRNG keys, symbolic dims)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    try:
+        if jax.dtypes.issubdtype(dtype, jax.dtypes.extended):
+            return None
+        size = 1
+        for d in shape:
+            size *= int(d)
+        return int(size * dtype.itemsize)
+    except Exception:
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatInput:
+    """One flattened input leaf of the traced call: its position in
+    ``closed.in_avals``, a human label (argname + pytree keypath), its
+    aval, and whether the caller donates it."""
+
+    index: int
+    label: str
+    aval: Any
+    donated: bool
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Everything a rule needs from ONE abstract trace."""
+
+    closed: Any                      # ClosedJaxpr from jax.make_jaxpr
+    inputs: List[FlatInput]
+    out_avals: List[Any]
+    fn_name: str
+
+
+def _arg_names(fn, nargs: int) -> List[str]:
+    """Positional parameter names of ``fn`` (labels + donate_argnames
+    resolution); falls back to argN for builtins/odd signatures."""
+    import inspect
+    try:
+        params = [p for p in inspect.signature(fn).parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY,
+                                p.POSITIONAL_OR_KEYWORD)]
+        names = [p.name for p in params[:nargs]]
+    except (TypeError, ValueError):
+        names = []
+    names += [f"arg{i}" for i in range(len(names), nargs)]
+    return names
+
+
+def trace_for_lint(fn, *args, donate_argnums=(), donate_argnames=(),
+                   **kwargs) -> LintContext:
+    """One abstract trace of ``fn`` into a :class:`LintContext`.
+
+    ``fn`` must be the PYTHON function (pre-jit) — pass a
+    ``track_retraces`` wrapper's ``python_fn``, never the counted/jitted
+    callable, or the lint trace itself would burn a watchdog budget.
+    ``donate_argnums``/``donate_argnames`` describe what the real call
+    site's ``jax.jit`` donates; they do not change the trace, only the
+    donation marks rules read."""
+    from jax import tree_util as jtu
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    names = _arg_names(fn, len(args))
+    donated_pos = {int(i) for i in (donate_argnums or ())}
+    donated_names = {str(n) for n in (donate_argnames or ())}
+    for nm in donated_names:
+        if nm in names:
+            donated_pos.add(names.index(nm))
+
+    leaves = jtu.tree_flatten_with_path((tuple(args), dict(kwargs)))[0]
+    inputs: List[FlatInput] = []
+    for idx, (kp, _leaf) in enumerate(leaves):
+        if idx >= len(closed.in_avals):      # defensive: never misalign
+            break
+        head, rest = kp[1], kp[2:]           # kp[0] is the (args, kwargs)
+        if isinstance(head, jtu.SequenceKey):  # positional arg
+            nm = names[head.idx] if head.idx < len(names) \
+                else f"arg{head.idx}"
+            donated = head.idx in donated_pos
+        else:                                  # keyword arg
+            nm = str(getattr(head, "key", head))
+            donated = nm in donated_names
+        label = nm + jtu.keystr(tuple(rest))
+        inputs.append(FlatInput(idx, label, closed.in_avals[idx], donated))
+
+    fn_name = getattr(fn, "__qualname__", None) or getattr(
+        fn, "__name__", type(fn).__name__)
+    return LintContext(closed=closed, inputs=inputs,
+                       out_avals=list(closed.out_avals), fn_name=fn_name)

@@ -1,0 +1,302 @@
+"""Pluggable graph-lint rules over one abstract trace (LintContext).
+
+Each rule is a small dataclass with a ``run(ctx) -> [Finding]`` method;
+byte thresholds default to ``FLAGS_graph_lint_donation_min_bytes`` /
+``FLAGS_graph_lint_widen_bytes`` / ``FLAGS_graph_lint_const_bytes`` but
+can be pinned per-instance (tests pass explicit rule instances to
+``analyze`` instead of moving global thresholds).  Severity convention: ``error`` = a
+perf/memory bug on a serving hot path (missed donation, captured weight,
+host callback in a step), ``warning`` = a hazard worth a look (a
+widening that might be a deliberate accumulator, a weak-typed scalar
+that has not retraced *yet*).
+
+The motivating catch (ISSUE 6): the serving engines' once-jitted step
+functions take and return the full KV cache; without buffer donation
+every tick double-buffers the dominant HBM consumer.  That is invisible
+at runtime (no error, no wrong tokens — just 2x cache HBM) and exactly
+the class of bug a trace-time aval check finds for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .. import flags as _flags
+from . import core
+
+__all__ = ["Rule", "DonationRule", "DtypePromotionRule",
+           "ConstantCaptureRule", "HostSyncRule", "RetraceHazardRule",
+           "default_rules"]
+
+# primitives that round-trip through the host mid-graph: callbacks block
+# the device stream on Python, infeed/outfeed block on host buffers —
+# inside a serving step any of them serializes the tick loop
+HOST_SYNC_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed",
+})
+
+_WIDE_PAIRS = {("bfloat16", "float32"), ("bfloat16", "float64"),
+               ("float16", "float32"), ("float16", "float64"),
+               ("float32", "float64")}
+
+
+class Rule:
+    """Base: ``name``/``severity`` class attrs + ``run(ctx)``."""
+
+    name = "rule"
+    severity = "warning"
+
+    def run(self, ctx: core.LintContext) -> List[core.Finding]:
+        raise NotImplementedError
+
+    def _finding(self, path: str, message: str,
+                 bytes: Optional[int] = None) -> core.Finding:
+        return core.Finding(self.name, self.severity, path, message, bytes)
+
+
+@dataclasses.dataclass
+class DonationRule(Rule):
+    """Jitted outputs whose aval matches a NON-donated input.
+
+    XLA aliases a donated input's buffer to a matching output in place;
+    without the donation the runtime must keep both live across the call
+    — for a step function that threads a big carry (the serving KV
+    cache), that is a silent 2x on the dominant HBM consumer.  Matching
+    is by aval (shape+dtype) multiset: an output first consumes a
+    donated input of its aval (fine), then a non-donated one (finding,
+    sized at the buffer it double-buffers)."""
+
+    min_bytes: Optional[int] = None
+
+    name = "donation"
+    severity = "error"
+
+    def run(self, ctx: core.LintContext) -> List[core.Finding]:
+        thr = (self.min_bytes if self.min_bytes is not None
+               else int(_flags.flag("graph_lint_donation_min_bytes")))
+        free = defaultdict(list)      # aval key -> un-donated FlatInputs
+        donated = defaultdict(int)    # aval key -> donated input count
+        for fi in ctx.inputs:
+            if core.aval_bytes(fi.aval) is None:
+                continue
+            key = (tuple(fi.aval.shape), str(fi.aval.dtype))
+            if fi.donated:
+                donated[key] += 1
+            else:
+                free[key].append(fi)
+        out: List[core.Finding] = []
+        for i, av in enumerate(ctx.out_avals):
+            b = core.aval_bytes(av)
+            if b is None or b < thr:
+                continue
+            key = (tuple(av.shape), str(av.dtype))
+            if donated[key] > 0:      # rides a donated buffer: fine
+                donated[key] -= 1
+                continue
+            if free[key]:
+                fi = free[key].pop(0)
+                out.append(self._finding(
+                    "",
+                    f"output {i} ({av.str_short()}) has the same aval as "
+                    f"un-donated input '{fi.label}' — without "
+                    f"donate_argnums both buffers stay live across the "
+                    f"call, double-buffering {b} bytes of HBM; donate "
+                    f"the input to alias it in place",
+                    bytes=b))
+        return out
+
+
+@dataclasses.dataclass
+class DtypePromotionRule(Rule):
+    """f32/f64 ``convert_element_type`` widenings of large low-precision
+    operands — on a bf16 decode path a stray ``.astype(float32)`` doubles
+    the bytes a weight-stream-bound step must move.  Deliberate
+    accumulators (softmax/norm reductions) live inside named regions;
+    the ``allow`` list matches path substrings (pjit/remat regions carry
+    the traced function's name — see ``core.iter_eqns``)."""
+
+    min_bytes: Optional[int] = None
+    allow: Tuple[str, ...] = ("softmax", "norm", "logsumexp")
+
+    name = "dtype-promotion"
+    severity = "warning"
+
+    def run(self, ctx: core.LintContext) -> List[core.Finding]:
+        thr = (self.min_bytes if self.min_bytes is not None
+               else int(_flags.flag("graph_lint_widen_bytes")))
+        out: List[core.Finding] = []
+        for path, eqn in core.iter_eqns(ctx.closed.jaxpr):
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src = getattr(eqn.invars[0], "aval", None)
+            new = eqn.params.get("new_dtype")
+            sd = getattr(src, "dtype", None)
+            if sd is None or new is None:
+                continue
+            if (str(sd), str(new)) not in _WIDE_PAIRS:
+                continue
+            nb = core.aval_bytes(src)
+            if nb is None or nb < thr:
+                continue
+            if any(a in path for a in self.allow):
+                continue
+            wide = nb // sd.itemsize * np.dtype(new).itemsize
+            out.append(self._finding(
+                path,
+                f"{src.str_short()} widened to {new} ({nb} -> {wide} "
+                f"bytes) on a low-precision path — if this is a "
+                f"softmax/norm accumulator, put it in a named region "
+                f"on the allowlist; otherwise it double-charges the "
+                f"memory-bound step",
+                bytes=wide))
+        return out
+
+
+@dataclasses.dataclass
+class ConstantCaptureRule(Rule):
+    """Large arrays baked into the jaxpr as consts: a weight closed over
+    instead of passed as an argument costs HBM alongside the live copy
+    (XLA embeds or uploads it per-executable) and forces a RETRACE when
+    the python value is swapped — the before-the-fact twin of the
+    retrace watchdog's budget."""
+
+    min_bytes: Optional[int] = None
+
+    name = "constant-capture"
+    severity = "error"
+
+    def run(self, ctx: core.LintContext) -> List[core.Finding]:
+        thr = (self.min_bytes if self.min_bytes is not None
+               else int(_flags.flag("graph_lint_const_bytes")))
+        out: List[core.Finding] = []
+
+        def scan(constvars, consts, path):
+            for cv, c in zip(constvars, consts):
+                b = core.aval_bytes(getattr(cv, "aval", None))
+                if b is None:
+                    b = getattr(c, "nbytes", None)
+                if b is None or b < thr:
+                    continue
+                out.append(self._finding(
+                    path,
+                    f"large constant {cv.aval.str_short()} captured into "
+                    f"the jaxpr — closed-over arrays are re-uploaded per "
+                    f"executable and retrace when replaced; pass it as "
+                    f"an argument",
+                    bytes=int(b)))
+
+        scan(ctx.closed.jaxpr.constvars, ctx.closed.consts, "")
+        seen = set()
+        for path, eqn in core.iter_eqns(ctx.closed.jaxpr):
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (tuple, list)) else [v]
+                for item in vals:
+                    if (hasattr(item, "consts") and hasattr(item, "jaxpr")
+                            and id(item) not in seen):
+                        seen.add(id(item))
+                        scan(item.jaxpr.constvars, item.consts,
+                             f"{path}/{eqn.primitive.name}")
+        return out
+
+
+@dataclasses.dataclass
+class HostSyncRule(Rule):
+    """Host round-trips inside a traced program: ``pure_callback`` /
+    ``io_callback`` / ``debug_callback`` / infeed / outfeed block the
+    device pipeline on Python — inside a serving step they serialize the
+    tick loop.  ``allow`` substrings match the callback target's
+    ``module.qualname`` (paddle_tpu.observability is allowlisted: its
+    trace-TIME counter hooks are python side effects that never lower to
+    callback primitives, but any future observability callback is a
+    deliberate one)."""
+
+    allow: Tuple[str, ...] = ("paddle_tpu.observability",)
+
+    name = "host-sync"
+    severity = "error"
+
+    @staticmethod
+    def _target(eqn) -> str:
+        cb = eqn.params.get("callback")
+        inner = getattr(cb, "callback_func", None) or cb
+        if inner is None:
+            return ""
+        mod = getattr(inner, "__module__", "") or ""
+        qual = (getattr(inner, "__qualname__", "")
+                or type(inner).__name__)
+        return f"{mod}.{qual}"
+
+    def run(self, ctx: core.LintContext) -> List[core.Finding]:
+        out: List[core.Finding] = []
+        for path, eqn in core.iter_eqns(ctx.closed.jaxpr):
+            nm = eqn.primitive.name
+            if nm not in HOST_SYNC_PRIMS:
+                continue
+            target = self._target(eqn)
+            if target and any(a in target for a in self.allow):
+                continue
+            out.append(self._finding(
+                path,
+                f"{nm}{' -> ' + target if target else ''} inside the "
+                f"traced graph — a host round-trip serializes the device "
+                f"pipeline (a serving tick would block on Python every "
+                f"step); hoist it out or allowlist a deliberate hook"))
+        return out
+
+
+@dataclasses.dataclass
+class RetraceHazardRule(Rule):
+    """Weak-typed scalars and non-canonical dtypes in the traced call's
+    INPUTS — the shapes of retrace bugs the watchdog (observability/
+    watchdog.py) catches after the fact, checked before it: a python
+    scalar leaking into a jitted call signature is one strong-typed
+    caller away from a second compilation."""
+
+    name = "retrace-hazard"
+    severity = "warning"
+
+    def run(self, ctx: core.LintContext) -> List[core.Finding]:
+        out: List[core.Finding] = []
+        for fi in ctx.inputs:
+            av = fi.aval
+            dt = getattr(av, "dtype", None)
+            if dt is None:
+                continue
+            try:
+                if jax.dtypes.issubdtype(dt, jax.dtypes.extended):
+                    continue                     # PRNG keys etc.
+            except Exception:
+                continue
+            if getattr(av, "weak_type", False):
+                out.append(self._finding(
+                    "",
+                    f"input '{fi.label}' is weak-typed "
+                    f"({av.str_short()}): a Python scalar leaked into "
+                    f"the call — the same site called with a "
+                    f"strongly-typed value retraces; pass np/jnp-typed "
+                    f"scalars"))
+                continue
+            try:
+                canon = jax.dtypes.canonicalize_dtype(dt)
+            except Exception:
+                continue
+            if canon != dt:
+                out.append(self._finding(
+                    "",
+                    f"input '{fi.label}' carries non-canonical dtype "
+                    f"{dt} (canonicalizes to {canon}) — mixed x64/x32 "
+                    f"callers retrace against each other"))
+        return out
+
+
+def default_rules() -> Tuple[Rule, ...]:
+    """Fresh instances of the full rule set (thresholds read the
+    graph-lint byte-threshold flags at run time)."""
+    return (DonationRule(), DtypePromotionRule(), ConstantCaptureRule(),
+            HostSyncRule(), RetraceHazardRule())

@@ -83,6 +83,22 @@ def _observability_guard():
     flags.set_flags({"retrace_watchdog": old})
 
 
+@pytest.fixture(autouse=True)
+def _graph_lint_guard():
+    """Graph lint ARMED at 'warn' for every test: each ServingEngine
+    self-lints its once-jitted step at the first tick (one abstract
+    trace — donation / dtype / const-capture / host-sync / retrace
+    rules, paddle_tpu/static_analysis), so a hot-path regression
+    surfaces as a GraphLintWarning in ANY serving test.  The dedicated
+    lint tests escalate to 'raise' themselves."""
+    from paddle_tpu import flags
+
+    old = flags.flag("graph_lint")
+    flags.set_flags({"graph_lint": "warn"})
+    yield
+    flags.set_flags({"graph_lint": old})
+
+
 @pytest.fixture
 def mesh8():
     import numpy as np

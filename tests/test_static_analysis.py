@@ -1,0 +1,271 @@
+"""Graph-lint suite (ISSUE 6): paddle_tpu/static_analysis.
+
+Contract per rule: one synthetic OFFENDER the rule must flag and one
+clean fixture it must pass — plus the serving integration, where the
+donation rule demonstrably catches the PRE-FIX engine step (cache not
+donated) and the fixed engines lint to zero findings in every cache
+layout with FLAGS_graph_lint armed at 'raise'.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags
+from paddle_tpu import static_analysis as sa
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.serving import ServingEngine
+
+MAXLEN = 64
+BIG = (256, 256)          # 256 KiB f32 / 128 KiB bf16 — over the 64 KiB
+                          # donation/widen thresholds, under const's 1 MiB
+
+
+@pytest.fixture(scope="module")
+def lm():
+    pt.seed(7)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    return model
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- donation ---------------------------------------------------------------
+
+def test_donation_rule_flags_undonated_carry():
+    def step(cache, tok):
+        return cache.at[0].add(1.0), tok + 1
+
+    cache = jnp.zeros(BIG)
+    tok = jnp.zeros((4,), jnp.int32)
+    found = _only(sa.analyze(step, cache, tok), "donation")
+    assert found, "un-donated carry must be flagged"
+    f = found[0]
+    assert f.severity == "error"
+    assert f.bytes == cache.nbytes
+    assert "cache" in f.message          # labelled by argname
+
+    # the fix — donating the carry — is the clean fixture
+    clean = sa.analyze(step, cache, tok, donate_argnums=(0,))
+    assert not _only(clean, "donation")
+
+
+def test_donation_rule_min_bytes_threshold():
+    """Small aval coincidences (token vectors in == token vectors out)
+    stay below the byte floor."""
+    def step(tok):
+        return tok + 1
+
+    fs = sa.analyze(step, jnp.zeros((8,), jnp.int32))
+    assert not _only(fs, "donation")
+    # shrink the threshold and the same program IS a finding
+    fs = sa.analyze(step, jnp.zeros((8,), jnp.int32),
+                    rules=[sa.DonationRule(min_bytes=1)])
+    assert _only(fs, "donation")
+
+
+# -- dtype promotion --------------------------------------------------------
+
+def test_dtype_promotion_rule_flags_large_widen():
+    def offender(x):
+        return x.astype(jnp.float32).sum()
+
+    x = jnp.zeros(BIG, jnp.bfloat16)
+    found = _only(sa.analyze(offender, x), "dtype-promotion")
+    assert found and found[0].bytes == x.size * 4
+
+    # allowlisted region: the SAME widening inside a jit-named
+    # softmax accumulator passes (path carries the traced fn's name)
+    def softmax_accum(x):
+        return x.astype(jnp.float32).sum()
+
+    def clean(x):
+        return jax.jit(softmax_accum)(x)
+
+    assert not _only(sa.analyze(clean, x), "dtype-promotion")
+    # small operands widen for free
+    assert not _only(sa.analyze(offender, jnp.zeros((8,), jnp.bfloat16)),
+                     "dtype-promotion")
+
+
+# -- constant capture -------------------------------------------------------
+
+def test_constant_capture_rule_flags_closed_over_weight():
+    big = jnp.ones((600, 600))           # 1.44 MB > the 1 MiB default
+
+    def offender(x):
+        return x + big
+
+    found = _only(sa.analyze(offender, jnp.ones((600, 600))),
+                  "constant-capture")
+    assert found and found[0].bytes == big.nbytes
+
+    def clean(x, w):
+        return x + w
+
+    assert not _only(sa.analyze(clean, jnp.ones((600, 600)), big),
+                     "constant-capture")
+
+
+def test_constant_capture_seen_through_nested_jit():
+    big = jnp.ones((600, 600))
+
+    def inner(x):
+        return x + big
+
+    def offender(x):
+        return jax.jit(inner)(x)
+
+    assert _only(sa.analyze(offender, jnp.ones((600, 600))),
+                 "constant-capture")
+
+
+# -- host sync --------------------------------------------------------------
+
+def test_host_sync_rule_flags_callbacks_and_allowlists():
+    def cb(v):
+        return np.asarray(v)
+
+    def offender(x):
+        return jax.pure_callback(
+            cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    x = jnp.ones((4,))
+    found = _only(sa.analyze(offender, x), "host-sync")
+    assert found and "pure_callback" in found[0].message
+
+    from jax.experimental import io_callback
+
+    def offender_io(x):
+        return io_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    assert _only(sa.analyze(offender_io, x), "host-sync")
+
+    # allowlist matches the callback target's module.qualname — the
+    # contract the observability hooks ride
+    allowed = sa.analyze(
+        offender, x,
+        rules=[sa.HostSyncRule(
+            allow=("test_host_sync_rule_flags_callbacks",))])
+    assert not allowed
+
+    def clean(x):
+        return x * 2.0
+
+    assert not sa.analyze(clean, x)
+
+
+# -- retrace hazard ---------------------------------------------------------
+
+def test_retrace_hazard_rule_flags_weak_scalars():
+    def f(x, s):
+        return x * s
+
+    found = _only(sa.analyze(f, jnp.ones((8,)), 3.0), "retrace-hazard")
+    assert found and "'s'" in found[0].message and "weak" in found[0].message
+    # strongly-typed scalar: clean
+    assert not sa.analyze(f, jnp.ones((8,)), np.float32(3.0))
+
+
+# -- API shape --------------------------------------------------------------
+
+def test_check_raises_and_findings_are_structured():
+    def step(cache):
+        return cache + 1.0
+
+    with pytest.raises(sa.GraphLintError, match="donation"):
+        sa.check(step, jnp.zeros(BIG))
+    d = sa.analyze(step, jnp.zeros(BIG))[0].as_dict()
+    assert set(d) == {"rule", "severity", "path", "message", "bytes"}
+
+
+def test_enforce_follows_graph_lint_flag():
+    fs = [sa.Finding("donation", "error", "", "synthetic", 123)]
+    old = flags.flag("graph_lint")
+    try:
+        flags.set_flags({"graph_lint": "off"})
+        assert sa.enforce(fs) is fs
+        flags.set_flags({"graph_lint": "warn"})
+        with pytest.warns(sa.GraphLintWarning, match="synthetic"):
+            sa.enforce(fs)
+        flags.set_flags({"graph_lint": "raise"})
+        with pytest.raises(sa.GraphLintError, match="synthetic"):
+            sa.enforce(fs)
+    finally:
+        flags.set_flags({"graph_lint": old})
+
+
+def test_collective_lint_rides_the_shared_core():
+    """The refactor satellite: distributed/lint.py is a client of
+    static_analysis.core — one version-compat surface."""
+    from paddle_tpu.distributed import lint
+    from paddle_tpu.static_analysis import core
+
+    assert lint._sub_jaxprs is core.sub_jaxprs
+    assert lint._CANONICAL is core.CANONICAL
+
+
+# -- serving integration ----------------------------------------------------
+
+def _engine_kwargs(paged, chunked):
+    kw = {}
+    if paged:
+        kw.update(paged=True, block_len=16)
+    if chunked:
+        kw.update(chunked=True, prefill_chunk=8)
+    return kw
+
+
+@pytest.mark.parametrize("paged,chunked", [(False, False), (True, False),
+                                           (False, True), (True, True)])
+def test_donation_rule_catches_prefix_engine_step(lm, paged, chunked):
+    """ISSUE 6 acceptance: the PRE-FIX engine step — the raw impl traced
+    WITHOUT the threaded donate_argnums — double-buffers the cache, and
+    the donation rule says so, sized at exactly the cache bytes.  The
+    TrackedFunction path (donation threaded) is clean."""
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        **_engine_kwargs(paged, chunked))
+    raw = eng._step_fn.python_fn         # pre-jit body, no donation info
+    found = _only(sa.analyze(raw, *eng._lint_args()), "donation")
+    assert found, "pre-fix step must double-buffer the cache"
+    assert found[0].bytes == eng.cache_hbm_bytes
+    assert "cache" in found[0].message
+    # post-fix: the tracked step (donate_argnums threaded) lints clean
+    assert eng.lint_step() == []
+
+
+@pytest.mark.parametrize("paged,chunked", [(False, False), (True, False),
+                                           (False, True), (True, True)])
+def test_serving_engine_lints_clean_armed(lm, paged, chunked):
+    """The armed contract: FLAGS_graph_lint='raise' + a real request —
+    the first-tick self-lint must find NOTHING in any cache layout (and
+    generation still works, proving the lint ran on the live step)."""
+    old = flags.flag("graph_lint")
+    flags.set_flags({"graph_lint": "raise"})
+    try:
+        eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                            **_engine_kwargs(paged, chunked))
+        prompt = np.random.RandomState(5).randint(0, 256, 6).astype(
+            np.int32)
+        rid = eng.submit(prompt, max_new_tokens=3)
+        out = dict(eng.drain())
+        assert len(out[rid]) == 3
+        assert eng._linted
+        assert eng.step_traces == 1      # the lint trace is abstract
+    finally:
+        flags.set_flags({"graph_lint": old})
+
+
+def test_cli_reports_zero_findings():
+    """`python -m paddle_tpu.static_analysis` (in-process): zero
+    findings on the tiny-config engine step in both cache layouts,
+    exit status 0."""
+    from paddle_tpu.static_analysis.__main__ import main
+
+    assert main(["--slots", "2", "--max-length", "64",
+                 "--block-len", "16", "--prefill-chunk", "8"]) == 0
