@@ -185,6 +185,30 @@ DEFINE("serving_chunk_policy", "prefill",
        "prompt chunk on every tick (fastest TTFT); 'decode' interleaves "
        "— while any slot is decoding, chunks run on alternate ticks "
        "only, halving prefill bandwidth to protect TPOT further")
+# speculative decoding (serving/engine.py + serving/drafter.py): at b=1
+# decode sits AT the bf16 weight-stream floor (BENCH_DECODE.json), so the
+# only way faster is amortising each weight pass over several tokens —
+# score a host-drafted window through the q-tiled flash-decode path in
+# ONE step and keep the longest verified prefix
+DEFINE("serving_spec_decode", False,
+       "ServingEngine default decode mode: True = speculative decoding "
+       "(a host-side n-gram self-drafter proposes up to "
+       "FLAGS_serving_spec_k tokens per slot per tick; one mixed verify "
+       "step scores them all and greedy rows accept the longest matching "
+       "prefix, 1..k+1 tokens per step).  Greedy outputs stay "
+       "token-identical to plain decode; sampled rows fall back to one "
+       "token per step.  Engine constructor arg overrides")
+DEFINE("serving_spec_k", 4,
+       "speculative draft window: max draft tokens proposed per slot per "
+       "verify step.  Static — the verify step is compiled for q-depth "
+       "k+1, so every tick runs the same program whether drafts hit or "
+       "not (no-draft rows ride along as effective depth-1 decode).  "
+       "Larger k amortises the weight stream further when drafts hit but "
+       "wastes verify compute (and, paged, block churn) when they miss")
+DEFINE("serving_spec_ngram", 3,
+       "longest n-gram the prompt-lookup self-drafter matches against "
+       "each slot's prompt+generated history when proposing drafts "
+       "(it backs off to shorter n-grams, floor 1, before giving up)")
 # graph lint (paddle_tpu/static_analysis): jaxpr static analysis of the
 # serving hot path — donation, dtype widening, constant capture,
 # host-sync, retrace hazards — one abstract trace, before any device run

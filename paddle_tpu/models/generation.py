@@ -92,6 +92,57 @@ def sample_tokens(logits, key, temperature=0.0, top_k=None, top_p=None):
     return jnp.where(temperature <= 0.0, greedy, samp)
 
 
+def accept_draft_tokens(logits, drafts, draft_mask, key, temperature=0.0,
+                        top_k=None, top_p=None, pad_token_id: int = 0):
+    """Accept-longest-prefix verification for speculative decoding — the
+    in-graph half of the serving engine's spec-decode step (the drafter
+    lives on the host: serving/drafter.py).
+
+    One verify pass scored the window ``[t, d_1 .. d_{S-1}]`` (current
+    token + S-1 proposed drafts) in a single forward, so ``logits[:, j]``
+    is the next-token distribution AFTER consuming the window's first
+    j+1 tokens.  Each position j samples a token via
+    :func:`sample_tokens` (its own ``fold_in(key, j)`` subkey, the same
+    per-row temperature/top-k/top-p vectors the plain step uses); a
+    draft ``d_{j+1}`` is *verified* when position j's sampled token
+    equals it, and the row commits the longest verified prefix plus one
+    bonus token — 1 to S tokens per step.
+
+    Acceptance policy: **greedy rows** (``temperature <= 0``) match
+    against the argmax, so the committed stream is token-identical to
+    plain one-token-per-step greedy decode (the exact-parity case of
+    Leviathan et al. 2023).  **Sampled rows** accept only position 0 —
+    plain decode behaviour, keeping the sampling distribution exact
+    instead of approximating rejection sampling.
+
+    ``logits``: (B, S, V); ``drafts``: int (B, S-1); ``draft_mask``:
+    bool (B, S-1), True where the column holds a real proposal (pad
+    columns can never be "verified", even if the model happens to emit
+    the pad id).  Returns ``(tokens, n_accepted)``: int32 (B, S) whose
+    columns past each row's ``n_accepted`` are ``pad_token_id``, and
+    int32 (B,) in [1, S].
+    """
+    b, s, _ = logits.shape
+    out = jnp.stack(
+        [sample_tokens(logits[:, j], jax.random.fold_in(key, j),
+                       temperature, top_k, top_p) for j in range(s)],
+        axis=1)                                            # (B, S)
+    if s == 1:
+        return out, jnp.ones((b,), jnp.int32)
+    match = (out[:, :-1] == drafts) & draft_mask           # (B, S-1)
+    if isinstance(temperature, (int, float)):
+        if temperature > 0.0:
+            match = jnp.zeros_like(match)
+    else:
+        match = match & (temperature <= 0.0)[:, None]
+    # longest verified prefix: cumprod zeroes everything past the first
+    # mismatch; +1 is the bonus token the last verified position earned
+    n = (1 + jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                     axis=1)).astype(jnp.int32)
+    keep = jnp.arange(s)[None, :] < n[:, None]
+    return jnp.where(keep, out, jnp.int32(pad_token_id)), n
+
+
 def _place_on_mesh(model, params, cache, input_ids, paged_cache=False):
     """Mesh-native decode (round-3 verdict #3): when a hybrid mesh is
     active, lay the decode state out on it before jitting —

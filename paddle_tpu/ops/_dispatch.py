@@ -2,16 +2,47 @@
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 
 import jax
 
 from .. import flags
 
+# trace-time kernel-path relabel hint (see kernel_path_hint): thread-local
+# so concurrent traces (pytest-xdist, background compiles) don't cross
+_HINT = threading.local()
+
 
 @functools.cache
 def default_backend() -> str:
     return jax.default_backend()
+
+
+@contextlib.contextmanager
+def kernel_path_hint(op: str):
+    """Relabel ``ops.kernel_path`` counts made while the context is open.
+
+    Dispatch counting happens at TRACE time, so a caller that knows what a
+    shape *means* — the serving engine tracing its speculative-decode
+    verify step, where the q window is draft tokens, not a prefill chunk —
+    wraps the traced call and every routing decision inside lands under
+    ``op=<hint>`` (e.g. ``spec_verify``) instead of the generic op name.
+    Purely an observability relabel: routing itself is unchanged.
+    """
+    prev = getattr(_HINT, "op", None)
+    _HINT.op = op
+    try:
+        yield
+    finally:
+        _HINT.op = prev
+
+
+def kernel_path_op(default: str) -> str:
+    """The op label a dispatch site should count under: the innermost
+    active :func:`kernel_path_hint`, or ``default``."""
+    return getattr(_HINT, "op", None) or default
 
 
 def use_pallas() -> bool:

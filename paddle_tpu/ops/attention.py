@@ -141,8 +141,11 @@ def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
     path, reason = _decode_attention_decision(b, s, hq, hkv, d, kv_len,
                                               has_extra_mask,
                                               paged_block_len)
+    # a kernel_path_hint (ops/_dispatch.py) relabels the decision — the
+    # serving engine's speculative verify step counts as op="spec_verify"
+    # so a draft window silently sliding off its path is its own series
     _dispatch.count_kernel_path(
-        "decode_attention", path,
+        _dispatch.kernel_path_op("decode_attention"), path,
         cache="paged" if paged_block_len is not None else "contiguous")
     return path, reason
 

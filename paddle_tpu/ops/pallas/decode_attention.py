@@ -54,6 +54,18 @@ clamp becomes per-tile (early q tiles skip the chunk's own later KV
 blocks — causal block skipping for free).  Routing for these shapes is
 counted under ``ops.kernel_path{op="chunked_prefill"}``.
 
+**Speculative verify** (serving/engine.py spec-decode steps): the q-tile
+machinery above IS the verify pass of self-drafted speculative decoding —
+a (B, k+1) window of [current token, k drafts] at per-row depths scores
+every draft in ONE pass of the weights, because the per-row ``pos`` mask
+already gives query offset ``si`` exactly the causal view "cached prefix
++ the window's own earlier tokens".  The dispatch contract is the
+chunked-prefill one (``s <= 2048``, ``s·G`` tiled at 64 rows), no new
+kernel surface; the engine wraps its verify trace in
+``ops._dispatch.kernel_path_hint("spec_verify")`` so these builds (and
+their routing decisions) land under ``ops.kernel_path{op="spec_verify"}``
+instead of the prefill-chunk label.
+
 **Paged KV cache** (serving/kv_cache.py): the kernel also serves the
 block-table layout, where the cache is one pooled ``(num_blocks,
 block_len, Hkv, D)`` array and each row's logical positions are backed by
@@ -265,10 +277,15 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
 
     # past every eligibility gate: this trace builds the kernel — count
     # which cache layout it was built for (routing visibility, trace-time
-    # side effect only); a tiled q walk is the chunked-prefill mode
+    # side effect only); a tiled q walk is the chunked-prefill mode, and
+    # an active kernel_path_hint relabels the build (the serving engine's
+    # speculative verify window counts as op="spec_verify" — same q-tiled
+    # machinery, different meaning: the q rows are draft tokens scored
+    # against the live cache, not a prompt chunk streaming in)
     from .. import _dispatch as _disp
     _disp.count_kernel_path(
-        "chunked_prefill" if nq > 1 else "decode_attention_kernel",
+        _disp.kernel_path_op(
+            "chunked_prefill" if nq > 1 else "decode_attention_kernel"),
         "paged" if block_tables is not None else "contiguous")
 
     kernel = functools.partial(

@@ -11,8 +11,9 @@ decode function compiled exactly once, and a host-side scheduler that
 admits queued requests into freed slots mid-flight.
 """
 
+from .drafter import NgramDrafter
 from .engine import Request, SamplingParams, ServingEngine
 from .kv_cache import BlockManager, init_paged_kv_cache
 
 __all__ = ["ServingEngine", "SamplingParams", "Request", "BlockManager",
-           "init_paged_kv_cache"]
+           "init_paged_kv_cache", "NgramDrafter"]

@@ -1,0 +1,69 @@
+"""Host-side self-drafting proposers for speculative decoding.
+
+The serving engine's spec-decode mode (engine.py) needs a cheap source of
+draft tokens: candidates the once-jitted verify step can score k at a
+time through the q-tiled flash-decode path, so an accepted draft costs a
+fraction of a weight pass instead of a whole one.  A second draft *model*
+would buy the best acceptance rates (Leviathan et al. 2023) but drags in
+a second set of weights, its own KV state and a second compiled program;
+**prompt lookup / n-gram self-drafting** (the vLLM ``ngram`` speculator,
+PLD) gets most of the win for free on the workloads speculative decoding
+targets anyway — summarisation, code edits, RAG, chat with long shared
+context — where the continuation frequently restates spans that already
+appear in the prompt or in the tokens generated so far.
+
+Everything here is pure host-side numpy over each slot's token history;
+nothing touches the device or the compiled step (a proposal is just data
+riding the verify step's static (num_slots, k) draft operand, pad-masked
+where the drafter had nothing to say).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NgramDrafter"]
+
+
+class NgramDrafter:
+    """Prompt-lookup proposer: match the history's tail n-gram against
+    its own earlier occurrences and propose the tokens that followed.
+
+    For ``n = max_ngram .. min_ngram`` (longest first — a longer context
+    match is a stronger continuation signal), find the MOST RECENT prior
+    occurrence of the last ``n`` tokens inside the history; on a hit,
+    propose the (up to) ``k`` tokens that followed it.  No hit at any n
+    ⇒ no proposal (the row rides the verify step as plain depth-1
+    decode).  Proposals are never fabricated — every draft token is
+    lifted verbatim from the history, which is what makes the scheme
+    free: no model, no state, no trace.
+    """
+
+    def __init__(self, k: int, max_ngram: int = 3, min_ngram: int = 1):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"[{min_ngram}, {max_ngram}]")
+        self.k = int(k)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, history) -> np.ndarray:
+        """Draft tokens following ``history`` (prompt + generated so
+        far, the last entry being the token about to be fed to the
+        model).  Returns int32 (m,) with ``0 <= m <= k``; empty means
+        "no match — decode plain"."""
+        h = np.asarray(history, np.int64).ravel()
+        n_hi = min(self.max_ngram, h.size - 1)
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            tail = h[h.size - n:]
+            # all length-n windows; the last one IS the tail, so a prior
+            # occurrence is any earlier window — take the most recent
+            win = np.lib.stride_tricks.sliding_window_view(h, n)
+            hits = np.flatnonzero((win[:-1] == tail).all(axis=1))
+            if hits.size:
+                i = int(hits[-1])
+                return h[i + n:i + n + self.k].astype(np.int32)
+        return np.zeros((0,), np.int32)

@@ -98,6 +98,41 @@ replaces the wave with a **token-budget scheduler**:
 Greedy outputs remain token-identical to the wave engine (and therefore
 to ``greedy_generate``) — tests/test_serving.py staggered traces with a
 long prompt arriving mid-decode assert it for both cache layouts.
+
+**Speculative decoding** (``spec_decode=True`` / FLAGS_serving_spec_decode):
+at b=1 the decode step already sits AT the bf16 weight-stream floor
+(BENCH_DECODE.json, 1.0–1.07x of bound), so no kernel tuning helps — the
+only lever left is amortising each pass of the weights over MORE than one
+token.  Spec mode does that without a second model:
+
+  * a host-side **self-drafter** (drafter.py: prompt-lookup / n-gram
+    match over each slot's prompt+generated history, the vLLM ``ngram``
+    speculator scheme) proposes up to ``spec_k`` (FLAGS_serving_spec_k)
+    tokens per greedy slot per tick;
+  * ONE once-jitted **verify step** feeds every row its (k+1)-token
+    window ``[current, d_1..d_k]`` at its own depth — exactly the
+    q-tiled mode the flash-decode kernel grew for chunked prefill, with
+    per-row positions riding scalar-prefetch as always — so all drafts
+    of all slots are scored in a single pass of the weights
+    (``ops.kernel_path{op="spec_verify"}`` counts the routing);
+  * ``accept_draft_tokens`` (models/generation.py) keeps each row's
+    longest verified prefix plus the bonus token — 1..k+1 tokens
+    committed per step, token-identical to plain greedy decode; sampled
+    rows accept one token (exact distribution, no approximation);
+  * **rollback** of a rejected suffix is bookkeeping, not device work:
+    contiguous rows simply don't advance past the accept point (stale
+    K/V above it is overwritten before any mask can read it), paged rows
+    additionally return draft-only blocks to the pool via
+    ``BlockManager.truncate_to`` (refcount/COW-safe, reservation
+    re-credited, trie invalidated past the cut);
+  * rows with no draft hit ride the SAME program as depth-1 decode (k is
+    static; absent drafts are pad columns masked out of acceptance, with
+    their junk writes steered exactly like idle rows' — past max_length
+    contiguous, into the null block paged), so the retrace budget stays
+    1 and the graph lint stays green in every layout.  Chunked prefill
+    composes: the mixed step's decode half becomes the verify window
+    while a prefilling slot — inactive by construction — drafts nothing
+    until its cursor completes.
 """
 
 from __future__ import annotations
@@ -115,8 +150,11 @@ import numpy as np
 
 from .. import flags as _flags
 from .. import observability as _obs
-from ..models.generation import _place_on_mesh, init_kv_cache, sample_tokens
+from ..models.generation import (_place_on_mesh, accept_draft_tokens,
+                                 init_kv_cache, sample_tokens)
 from ..nn.layer import bind_params
+from ..ops import _dispatch as _disp
+from .drafter import NgramDrafter
 from .kv_cache import BlockManager, init_paged_kv_cache
 
 __all__ = ["ServingEngine", "SamplingParams", "Request"]
@@ -158,6 +196,8 @@ class _Slot:
     rid: int
     remaining: int                     # new tokens still allowed
     t_first: float = 0.0               # perf_counter at first token (TPOT)
+    # the request's prompt — the self-drafter's lookup corpus (spec mode)
+    prompt: Optional[np.ndarray] = None
 
 
 @dataclasses.dataclass
@@ -189,7 +229,9 @@ class ServingEngine:
                  prefix_cache: Optional[bool] = None,
                  chunked: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
-                 chunk_policy: Optional[str] = None):
+                 chunk_policy: Optional[str] = None,
+                 spec_decode: Optional[bool] = None,
+                 spec_k: Optional[int] = None):
         """``paged`` (default FLAGS_serving_paged_kv) selects the paged
         block-pool cache; ``block_len`` (FLAGS_kv_cache_block_len) and
         ``num_blocks`` (FLAGS_kv_cache_num_blocks; 0 derives the
@@ -205,7 +247,16 @@ class ServingEngine:
         ``chunk_policy`` (FLAGS_serving_chunk_policy): 'prefill' runs a
         pending chunk every tick, 'decode' interleaves chunks with
         chunk-free ticks while decodes are active (TPOT protection at
-        half the prompt-ingest rate)."""
+        half the prompt-ingest rate).
+
+        ``spec_decode`` (default FLAGS_serving_spec_decode) selects
+        speculative decoding: the n-gram self-drafter proposes up to
+        ``spec_k`` (FLAGS_serving_spec_k) tokens per greedy slot per
+        tick and one verify step commits the longest verified prefix —
+        greedy outputs token-identical to plain decode, 1..k+1 tokens
+        per step.  Composes with every cache layout and with chunked
+        prefill (the verify window replaces the mixed step's decode
+        half)."""
         if hasattr(model, "init_decode_state"):
             raise NotImplementedError(
                 "ServingEngine requires the stacked KV cache; recurrent "
@@ -237,6 +288,16 @@ class ServingEngine:
         if self.chunked and self.prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
+        self.spec = bool(_flags.flag("serving_spec_decode")
+                         if spec_decode is None else spec_decode)
+        self.spec_k = int(spec_k or _flags.flag("serving_spec_k"))
+        if self.spec and self.spec_k < 1:
+            raise ValueError(
+                f"spec_k must be >= 1, got {self.spec_k}")
+        if self.spec:
+            self._drafter = NgramDrafter(
+                self.spec_k,
+                max_ngram=int(_flags.flag("serving_spec_ngram")))
         self._init_metrics()
 
         # quantized-decode hooks, exactly as models/generation.py binds
@@ -313,25 +374,30 @@ class ServingEngine:
             # decode rows plus one (possibly empty) prompt chunk, chunk
             # size static.  The budget of 1 IS the token-budget
             # scheduler's contract: admission, chunk progress and
-            # retirement all move through traced inputs.
+            # retirement all move through traced inputs.  Spec mode
+            # swaps the decode half for the (k+1)-deep verify window —
+            # still one static-shape program.
+            if self.spec:
+                impl = (self._spec_mixed_step_impl_paged if self.paged
+                        else self._spec_mixed_step_impl)
+            else:
+                impl = (self._mixed_step_impl_paged if self.paged
+                        else self._mixed_step_impl)
             self._step_fn = _obs.track_retraces(
-                self._mixed_step_impl_paged if self.paged
-                else self._mixed_step_impl,
-                "serving.step", budget=1, labels=lbl, **donate)
+                impl, "serving.step", budget=1, labels=lbl, **donate)
             self._prefill_fn = None
-        elif self.paged:
-            self._step_fn = _obs.track_retraces(
-                self._step_impl_paged, "serving.step", budget=1,
-                labels=lbl, **donate)
-            self._prefill_fn = _obs.track_retraces(
-                self._prefill_impl_paged, "serving.prefill",
-                budget=_PREFILL_TRACE_BUDGET, labels=lbl, **donate)
         else:
+            if self.spec:
+                impl = (self._spec_step_impl_paged if self.paged
+                        else self._spec_step_impl)
+            else:
+                impl = (self._step_impl_paged if self.paged
+                        else self._step_impl)
             self._step_fn = _obs.track_retraces(
-                self._step_impl, "serving.step", budget=1, labels=lbl,
-                **donate)
+                impl, "serving.step", budget=1, labels=lbl, **donate)
             self._prefill_fn = _obs.track_retraces(
-                self._prefill_impl, "serving.prefill",
+                self._prefill_impl_paged if self.paged
+                else self._prefill_impl, "serving.prefill",
                 budget=_PREFILL_TRACE_BUDGET, labels=lbl, **donate)
         self._linted = False           # first-tick self-lint (graph_lint)
 
@@ -410,6 +476,30 @@ class ServingEngine:
             "prompt's remaining chunks plus every queued prompt's",
             buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)).labels(
                 **lbl)
+        # speculative decoding (serving.spec* conventions: BASELINE.md) —
+        # accounting is in COMMITTED tokens; drafted/rejected tokens
+        # never reach serving.tokens_generated or any tok/s number
+        self._m_drafted = ctr(
+            "serving.spec_drafted_tokens",
+            "draft tokens the self-drafter proposed (sent to "
+            "verification)").labels(**lbl)
+        self._m_draft_hits = ctr(
+            "serving.spec_draft_hit_tokens",
+            "proposed draft tokens verified AND committed").labels(**lbl)
+        self._m_draft_miss = ctr(
+            "serving.spec_draft_miss_tokens",
+            "proposed draft tokens rejected by verification (rolled "
+            "back)").labels(**lbl)
+        self._m_rollbacks = ctr(
+            "serving.spec_rollbacks",
+            "row-steps whose rejected draft suffix was rolled back "
+            "(position pinned at the accept point; paged: draft-only "
+            "blocks returned via truncate_to)").labels(**lbl)
+        self._m_spec_accept = hist(
+            "serving.spec_accepted_per_step",
+            "tokens committed per active slot per verify step (1 = no "
+            "speculative win that step; k+1 = whole window accepted)",
+            buckets=(1, 2, 3, 4, 5, 6, 7, 8, 16)).labels(**lbl)
         self._m_step_traces = ctr(
             "jit.traces", "").labels(site="serving.step", **lbl)
         self._m_prefill_traces = ctr(
@@ -547,6 +637,107 @@ class ServingEngine:
                              ctemp, ctopk, ctopp)[0]
         return nxt, ctok, cache
 
+    # -- jitted device programs: speculative decoding ----------------------
+
+    def _verify_window(self, params, cache, tokens, positions, draft_ok,
+                       temps, topk, topp, key, block_tables=None):
+        """The shared verify core of every spec step: score each row's
+        (k+1)-token window ``[current, d_1..d_k]`` at its own depth in
+        ONE forward — q-depth k+1 rides the q-tiled flash-decode path,
+        per-row positions as scalar-prefetch, so all drafts of all slots
+        cost a single pass of the weights — then keep each row's longest
+        verified prefix plus the bonus token (models/generation.py
+        ``accept_draft_tokens``; sampled rows commit one token, exact
+        distribution).  The kernel_path_hint relabels this trace's
+        dispatch counts as ``op="spec_verify"``."""
+        with bind_params(self._bind, self._prepare(params)):
+            with _disp.kernel_path_hint("spec_verify"):
+                logits, cache = self.model.decode_step(
+                    tokens, cache, positions, block_tables=block_tables)
+        out, n_acc = accept_draft_tokens(
+            logits, tokens[:, 1:], draft_ok, key, temps, topk, topp,
+            pad_token_id=self.pad_token_id)
+        return out, n_acc, cache
+
+    def _spec_step_impl(self, params, cache, tokens, positions, slot_mask,
+                        draft_ok, temps, topk, topp, key):
+        """Speculative twin of ``_step_impl``: ``tokens`` is the
+        (num_slots, k+1) window matrix (pad columns where the drafter
+        had nothing), ``draft_ok`` the (num_slots, k) real-proposal
+        mask.  Row i writes K/V at ``positions[i]..positions[i]+k`` —
+        the host commits only the accepted prefix and never advances
+        past it, so rejected-suffix writes are dead cells the next steps
+        overwrite before any mask can read them (the same stale-tail
+        argument plain decode already relies on).  Compiled exactly
+        once; a draft-free tick is the same program with all-pad
+        windows."""
+        out, n_acc, cache = self._verify_window(
+            params, cache, tokens, positions, draft_ok, temps, topk,
+            topp, key)
+        out = jnp.where(slot_mask[:, None], out,
+                        jnp.int32(self.pad_token_id))
+        return out, n_acc, cache
+
+    def _spec_step_impl_paged(self, params, cache, tokens, positions,
+                              tables, slot_mask, draft_ok, temps, topk,
+                              topp, key):
+        """Paged twin of ``_spec_step_impl``: the block table rides
+        along; the host pre-grows each row's chain over its REAL draft
+        span (and COW-privatises it), while pad-column writes past the
+        chain steer to the null block — so a row near its reservation
+        ceiling never allocates for drafts it didn't propose."""
+        out, n_acc, cache = self._verify_window(
+            params, cache, tokens, positions, draft_ok, temps, topk,
+            topp, key, block_tables=tables)
+        out = jnp.where(slot_mask[:, None], out,
+                        jnp.int32(self.pad_token_id))
+        return out, n_acc, cache
+
+    def _spec_mixed_step_impl(self, params, cache, tokens, positions,
+                              slot_mask, draft_ok, temps, topk, topp,
+                              cids, cpos, clen, cslot, ctemp, ctopk,
+                              ctopp, key):
+        """Chunked × speculative (contiguous): ``_mixed_step_impl`` with
+        the decode half replaced by the verify window.  The chunk half
+        is untouched — a prefilling slot is inactive (its spec window
+        suspended) until its cursor completes, so the two halves never
+        touch the same row."""
+        out, n_acc, cache = self._verify_window(
+            params, cache, tokens, positions, draft_ok, temps, topk,
+            topp, key)
+        out = jnp.where(slot_mask[:, None], out,
+                        jnp.int32(self.pad_token_id))
+        row = jax.lax.dynamic_slice_in_dim(cache, cslot, 1, axis=2)
+        with bind_params(self._bind, self._prepare(params)):
+            clogits, row = self.model.decode_step(cids, row, cpos[None])
+        ctok = sample_tokens(clogits[0, clen - 1][None],
+                             jax.random.fold_in(key, 1),
+                             ctemp, ctopk, ctopp)[0]
+        z = jnp.int32(0)
+        cache = jax.lax.dynamic_update_slice(cache, row,
+                                             (z, z, cslot, z, z, z))
+        return out, n_acc, ctok, cache
+
+    def _spec_mixed_step_impl_paged(self, params, cache, tokens,
+                                    positions, tables, slot_mask,
+                                    draft_ok, temps, topk, topp, cids,
+                                    cpos, clen, ctable, ctemp, ctopk,
+                                    ctopp, key):
+        """Chunked × speculative (paged): verify window over the pool,
+        then the chunk half exactly as ``_mixed_step_impl_paged``."""
+        out, n_acc, cache = self._verify_window(
+            params, cache, tokens, positions, draft_ok, temps, topk,
+            topp, key, block_tables=tables)
+        out = jnp.where(slot_mask[:, None], out,
+                        jnp.int32(self.pad_token_id))
+        with bind_params(self._bind, self._prepare(params)):
+            clogits, cache = self.model.decode_step(
+                cids, cache, cpos[None], block_tables=ctable)
+        ctok = sample_tokens(clogits[0, clen - 1][None],
+                             jax.random.fold_in(key, 1),
+                             ctemp, ctopk, ctopp)[0]
+        return out, n_acc, ctok, cache
+
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt: Sequence[int],
@@ -605,7 +796,28 @@ class ServingEngine:
         with self._tracer.span("serving.step", tick=self._ticks):
             if self.chunked:
                 return self._step_inner_chunked()
+            if self.spec:
+                return self._step_inner_spec()
             return self._step_inner()
+
+    def _grow_row_for_writes(self, i: int, last_pos: int):
+        """Paged pre-dispatch bookkeeping for one slot about to write K/V
+        at ``positions[i]..last_pos``: grow the chain over every block
+        boundary in the span and COW-privatise each block in it (no-ops
+        unless a forking feature shared them), refreshing the uploaded
+        table row when anything changed.  Plain decode spans one
+        position; a spec verify step spans the row's real draft window."""
+        pos = int(self._positions[i])
+        changed = self.kv.ensure_capacity(i, last_pos)
+        for lb in range(pos // self.block_len,
+                        last_pos // self.block_len + 1):
+            cow = self.kv.ensure_writable(i, lb)
+            if cow is not None:
+                self._cache = self._cow_fn(self._cache, jnp.int32(cow[0]),
+                                           jnp.int32(cow[1]))
+                changed = True
+        if changed:
+            self._tables[i] = self.kv.table_row(i, self.max_blocks)
 
     def _step_inner(self) -> List[int]:
         finished = self._admit()
@@ -621,19 +833,8 @@ class ServingEngine:
                 for i, slot in enumerate(self._slots):
                     if slot is None:
                         continue
-                    # this tick writes K/V at positions[i]: grow the chain
-                    # over the block boundary and COW-privatise it (a no-op
-                    # unless a forking feature shared the tail block)
-                    pos = int(self._positions[i])
-                    grew = self.kv.ensure_capacity(i, pos)
-                    cow = self.kv.ensure_writable(i, pos // self.block_len)
-                    if cow is not None:
-                        self._cache = self._cow_fn(self._cache,
-                                                   jnp.int32(cow[0]),
-                                                   jnp.int32(cow[1]))
-                    if grew or cow is not None:
-                        self._tables[i] = self.kv.table_row(i,
-                                                            self.max_blocks)
+                    # this tick writes K/V at positions[i]
+                    self._grow_row_for_writes(i, int(self._positions[i]))
                 nxt, self._cache = self._step_fn(
                     self._params, self._cache,
                     jnp.asarray(self._tokens), jnp.asarray(self._positions),
@@ -665,6 +866,138 @@ class ServingEngine:
             slot.remaining -= 1
             self._m_tokens.inc()
             reason = self._finish_reason(tok, slot, i)
+            if reason is not None:
+                finished.append(slot.rid)
+                self._retire(slot, i, reason, now)
+        return finished
+
+    # -- speculative-decode scheduler (verify steps) -----------------------
+
+    def _propose_drafts(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The host draft phase: ask the n-gram self-drafter for up to
+        ``spec_k`` tokens per GREEDY active slot (sampled rows decode
+        plain — their distribution stays exact), capped so an accepted
+        window can never overrun the row's token budget
+        (``remaining - 1`` drafts ⇒ at most ``remaining`` commits) or
+        ``max_length - 1`` (every window write stays in bounds).
+        Returns the (num_slots, k) draft matrix (pad-filled) and the
+        bool real-proposal mask."""
+        s, k = self.num_slots, self.spec_k
+        drafts = np.full((s, k), self.pad_token_id, np.int32)
+        ok = np.zeros((s, k), bool)
+        for i, slot in enumerate(self._slots):
+            if slot is None or self._temps[i] > 0.0:
+                continue
+            cap = min(k, slot.remaining - 1,
+                      self.max_length - 1 - int(self._positions[i]))
+            if cap < 1:
+                continue
+            hist = np.concatenate(
+                [slot.prompt,
+                 np.asarray(self._results[slot.rid], np.int32)])
+            prop = self._drafter.propose(hist)[:cap]
+            if prop.size:
+                drafts[i, :prop.size] = prop
+                ok[i, :prop.size] = True
+                self._m_drafted.inc(int(prop.size))
+        return drafts, ok
+
+    def _step_inner_spec(self) -> List[int]:
+        """One speculative tick: wave admission unchanged, then draft on
+        the host and run ONE verify step over every slot's (k+1)-token
+        window.  Each row commits 1..k+1 tokens; the weight stream —
+        the b=1 bound BENCH_DECODE.json proves — is paid once either
+        way."""
+        finished = self._admit()
+        occ = int(self._active.sum())
+        self._set_occupancy(occ)
+        if not occ:
+            return finished
+        with self._tracer.span("serving.draft"):
+            drafts, draft_ok = self._propose_drafts()
+        window = np.concatenate([self._tokens[:, None], drafts], axis=1)
+        self._ticks += 1
+        key = jax.random.fold_in(self._base_key, self._ticks)
+        t0 = time.perf_counter()
+        with self._tracer.span("serving.verify", slots=occ,
+                               drafted=int(draft_ok.sum())):
+            if self.paged:
+                for i, slot in enumerate(self._slots):
+                    if slot is None:
+                        continue
+                    # grow/privatise over the row's REAL draft span only:
+                    # pad-column writes past the chain steer to the null
+                    # block, so no block is ever allocated for a draft
+                    # that was never proposed
+                    self._grow_row_for_writes(
+                        i, int(self._positions[i])
+                        + int(draft_ok[i].sum()))
+                out, n_acc, self._cache = self._step_fn(
+                    self._params, self._cache, jnp.asarray(window),
+                    jnp.asarray(self._positions), jnp.asarray(self._tables),
+                    jnp.asarray(self._active), jnp.asarray(draft_ok),
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), key)
+            else:
+                out, n_acc, self._cache = self._step_fn(
+                    self._params, self._cache, jnp.asarray(window),
+                    jnp.asarray(self._positions),
+                    jnp.asarray(self._active), jnp.asarray(draft_ok),
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), key)
+            out, n_acc = jax.device_get((out, n_acc))  # the one host sync
+        now = time.perf_counter()
+        self._m_step_ms.observe((now - t0) * 1e3)
+        finished.extend(self._advance_decode_spec(
+            np.asarray(out), np.asarray(n_acc), draft_ok, now))
+        return finished
+
+    def _advance_decode_spec(self, out: np.ndarray, n_acc: np.ndarray,
+                             draft_ok: np.ndarray, now: float
+                             ) -> List[int]:
+        """Per-slot bookkeeping after a verify step: commit each row's
+        accepted prefix — stopping AT an EOS inside the window — and
+        roll the rejected suffix back.  A multi-token accept is N tokens
+        in ONE step everywhere: ``tokens_generated`` += N, ONE
+        accepted-per-step observation, ONE retirement, and TPOT stays a
+        per-request retirement-time readout (never per-token)."""
+        finished: List[int] = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            n = int(n_acc[i])
+            drafted = int(draft_ok[i].sum())
+            take, reason = n, None
+            if self.eos_token_id is not None:
+                hits = np.where(out[i, :n] == self.eos_token_id)[0]
+                if hits.size:
+                    take, reason = int(hits[0]) + 1, "eos"
+            toks = [int(t) for t in out[i, :take]]
+            self._results[slot.rid].extend(toks)
+            self._positions[i] += take
+            self._tokens[i] = toks[-1]
+            slot.remaining -= take
+            self._m_tokens.inc(take)
+            self._m_spec_accept.observe(take)
+            if drafted:
+                # hits = committed draft tokens (the bonus token is free
+                # either way); misses = drafts verification rejected —
+                # an EOS cut discards verified drafts without counting
+                # them on either side
+                self._m_draft_hits.inc(take - 1)
+                self._m_draft_miss.inc(drafted - (n - 1))
+            if take <= drafted:
+                # the row wrote K/V past its accept point: pin the
+                # position (contiguous rollback is exactly that — the
+                # stale cells above it are rewritten before any mask
+                # reads them) and, paged, return draft-only blocks
+                self._m_rollbacks.inc()
+                if self.paged:
+                    self.kv.truncate_to(i, int(self._positions[i]))
+                    self._tables[i] = self.kv.table_row(i,
+                                                        self.max_blocks)
+            if reason is None:
+                reason = self._finish_reason(toks[-1], slot, i)
             if reason is not None:
                 finished.append(slot.rid)
                 self._retire(slot, i, reason, now)
@@ -711,25 +1044,30 @@ class ServingEngine:
             # drop past max_length, paged writes land in the null block
             clen, cslot = 1, 0
             cpos = 0 if self.paged else self.max_length
+        if self.spec:
+            # spec × chunked: the decode half becomes the verify window.
+            # A prefilling slot is inactive until its cursor completes,
+            # so its spec window is suspended by construction.
+            with self._tracer.span("serving.draft"):
+                drafts, draft_ok = self._propose_drafts()
+            window = np.concatenate([self._tokens[:, None], drafts],
+                                    axis=1)
         t0 = time.perf_counter()
         chunk_span = (self._tracer.span("serving.chunk", slot=cslot,
                                         start=cpos, tokens=clen)
                       if do_chunk else contextlib.nullcontext())
-        with self._tracer.span("serving.decode", slots=occ), chunk_span:
+        decode_span = self._tracer.span(
+            "serving.verify" if self.spec else "serving.decode",
+            slots=occ)
+        with decode_span, chunk_span:
             if self.paged:
                 for i, slot in enumerate(self._slots):
                     if slot is None:
                         continue
-                    pos = int(self._positions[i])
-                    grew = self.kv.ensure_capacity(i, pos)
-                    cow = self.kv.ensure_writable(i, pos // self.block_len)
-                    if cow is not None:
-                        self._cache = self._cow_fn(self._cache,
-                                                   jnp.int32(cow[0]),
-                                                   jnp.int32(cow[1]))
-                    if grew or cow is not None:
-                        self._tables[i] = self.kv.table_row(
-                            i, self.max_blocks)
+                    last = int(self._positions[i])
+                    if self.spec:
+                        last += int(draft_ok[i].sum())
+                    self._grow_row_for_writes(i, last)
                 if do_chunk:
                     # grow the chain to cover this chunk's real tokens;
                     # pad-tail positions fall past the chain and steer to
@@ -740,10 +1078,16 @@ class ServingEngine:
                                                self.max_blocks)[None]
                 else:
                     ctable = np.zeros((1, self.max_blocks), np.int32)
-                nxt, ctok, self._cache = self._step_fn(
-                    self._params, self._cache,
-                    jnp.asarray(self._tokens), jnp.asarray(self._positions),
-                    jnp.asarray(self._tables), jnp.asarray(self._active),
+                head = ((jnp.asarray(window), jnp.asarray(self._positions),
+                         jnp.asarray(self._tables),
+                         jnp.asarray(self._active), jnp.asarray(draft_ok))
+                        if self.spec else
+                        (jnp.asarray(self._tokens),
+                         jnp.asarray(self._positions),
+                         jnp.asarray(self._tables),
+                         jnp.asarray(self._active)))
+                res = self._step_fn(
+                    self._params, self._cache, *head,
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp),
                     jnp.asarray(cids), jnp.int32(cpos), jnp.int32(clen),
@@ -755,18 +1099,31 @@ class ServingEngine:
                 # owns those rows' contents now
                 dev_pos = np.where(self._active, self._positions,
                                    self.max_length).astype(np.int32)
-                nxt, ctok, self._cache = self._step_fn(
-                    self._params, self._cache,
-                    jnp.asarray(self._tokens), jnp.asarray(dev_pos),
-                    jnp.asarray(self._active), jnp.asarray(self._temps),
-                    jnp.asarray(self._topk), jnp.asarray(self._topp),
+                head = ((jnp.asarray(window), jnp.asarray(dev_pos),
+                         jnp.asarray(self._active), jnp.asarray(draft_ok))
+                        if self.spec else
+                        (jnp.asarray(self._tokens), jnp.asarray(dev_pos),
+                         jnp.asarray(self._active)))
+                res = self._step_fn(
+                    self._params, self._cache, *head,
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp),
                     jnp.asarray(cids), jnp.int32(cpos), jnp.int32(clen),
                     jnp.int32(cslot), jnp.asarray(ctemp),
                     jnp.asarray(ctopk), jnp.asarray(ctopp), key)
-            nxt, ctok = jax.device_get((nxt, ctok))  # the tick's one sync
+            if self.spec:
+                out, n_acc, ctok, self._cache = res
+                out, n_acc, ctok = jax.device_get((out, n_acc, ctok))
+            else:
+                nxt, ctok, self._cache = res
+                nxt, ctok = jax.device_get((nxt, ctok))  # the one sync
         now = time.perf_counter()
         self._m_step_ms.observe((now - t0) * 1e3)
-        finished.extend(self._advance_decode(np.asarray(nxt), now))
+        if self.spec:
+            finished.extend(self._advance_decode_spec(
+                np.asarray(out), np.asarray(n_acc), draft_ok, now))
+        else:
+            finished.extend(self._advance_decode(np.asarray(nxt), now))
         if do_chunk:
             finished.extend(self._advance_chunk(pf, clen, int(ctok), now))
         return finished
@@ -819,7 +1176,8 @@ class ServingEngine:
             return []
         si, req = pf.slot, pf.req
         self._prefill = None
-        slot = _Slot(req.request_id, req.max_new_tokens - 1, t_first=now)
+        slot = _Slot(req.request_id, req.max_new_tokens - 1, t_first=now,
+                     prompt=req.prompt)
         self._slots[si] = slot
         self._active[si] = True
         self._tokens[si] = ctok
@@ -895,6 +1253,13 @@ class ServingEngine:
         topk = jnp.zeros((s,), jnp.int32)
         topp = jnp.ones((s,), jnp.float32)
         key = jax.random.fold_in(self._base_key, 0)
+        if self.spec:
+            # the verify step's window matrix + real-proposal mask ride
+            # in place of the (s,) token vector
+            head = (jnp.zeros((s, self.spec_k + 1), jnp.int32), pos)
+            tail_mask = (mask, jnp.zeros((s, self.spec_k), bool))
+        else:
+            head, tail_mask = (toks, pos), (mask,)
         if self.chunked:
             cids = jnp.zeros((1, self.prefill_chunk), jnp.int32)
             cpos, clen = jnp.int32(0), jnp.int32(1)
@@ -904,18 +1269,18 @@ class ServingEngine:
             if self.paged:
                 tables = jnp.zeros((s, self.max_blocks), jnp.int32)
                 ctable = jnp.zeros((1, self.max_blocks), jnp.int32)
-                return (self._params, self._cache, toks, pos, tables,
-                        mask, temps, topk, topp, cids, cpos, clen,
+                return (self._params, self._cache, *head, tables,
+                        *tail_mask, temps, topk, topp, cids, cpos, clen,
                         ctable, ctemp, ctopk, ctopp, key)
-            return (self._params, self._cache, toks, pos, mask, temps,
+            return (self._params, self._cache, *head, *tail_mask, temps,
                     topk, topp, cids, cpos, clen, jnp.int32(0), ctemp,
                     ctopk, ctopp, key)
         if self.paged:
             tables = jnp.zeros((s, self.max_blocks), jnp.int32)
-            return (self._params, self._cache, toks, pos, tables, mask,
+            return (self._params, self._cache, *head, tables, *tail_mask,
                     temps, topk, topp, key)
-        return (self._params, self._cache, toks, pos, mask, temps, topk,
-                topp, key)
+        return (self._params, self._cache, *head, *tail_mask, temps,
+                topk, topp, key)
 
     def lint_step(self):
         """Graph-lint this engine's once-jitted step function (one
@@ -998,6 +1363,22 @@ class ServingEngine:
                 "prefill_chunks": int(self._m_chunks.value()),
                 "prefill_chunk_tokens": int(self._m_chunk_tokens.value()),
                 "chunk_queue_depth": hist(self._m_chunk_queue)}
+        if self.spec:
+            drafted = int(self._m_drafted.value())
+            hits = int(self._m_draft_hits.value())
+            acc = hist(self._m_spec_accept)
+            if acc["count"]:
+                acc["mean"] = round(
+                    self._m_spec_accept.sum / acc["count"], 3)
+            out["spec"] = {
+                "spec_k": self.spec_k,
+                "drafted_tokens": drafted,
+                "draft_hit_tokens": hits,
+                "draft_miss_tokens": int(self._m_draft_miss.value()),
+                "draft_hit_rate": (round(hits / drafted, 3) if drafted
+                                   else 0.0),
+                "rollbacks": int(self._m_rollbacks.value()),
+                "accepted_per_step": acc}
         if self.paged:
             st = self.kv.stats
             total = self.prefill_tokens_total
@@ -1138,7 +1519,7 @@ class ServingEngine:
         finished: List[int] = []
         for r, (req, si, m) in enumerate(wave):
             slot = _Slot(req.request_id, req.max_new_tokens - 1,
-                         t_first=t_tok)
+                         t_first=t_tok, prompt=req.prompt)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = tok[r]
@@ -1192,7 +1573,7 @@ class ServingEngine:
         finished: List[int] = []
         for r, (req, si) in enumerate(zip(wave, slots)):
             slot = _Slot(req.request_id, req.max_new_tokens - 1,
-                         t_first=t_tok)
+                         t_first=t_tok, prompt=req.prompt)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = tok[r]

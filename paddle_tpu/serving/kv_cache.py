@@ -63,6 +63,14 @@ blocks are immutable and tail blocks are private, so this never fires;
 it is the hook forking features (beam/speculative decode, n>1 sampling)
 build on, and it is unit-tested at this layer.
 
+Rollback: ``truncate_to`` is the inverse of ``ensure_capacity`` — the
+speculative-decode engine writes a draft window ahead of the committed
+position and, when verification rejects a suffix, rolls the chain back so
+blocks that only held rejected tokens return to the pool (reservation
+re-credited, shared blocks deref'd not freed, and every trie registration
+at or past the cut cascade-invalidated so a stale block can never serve a
+prefix hit afterwards).
+
 Admission is reservation-based so mid-flight allocation cannot fail: a
 request is admitted only if ``free + evictable - already-reserved`` covers
 every block it could ever need (prompt + max_new_tokens, minus the shared
@@ -429,6 +437,15 @@ class BlockManager:
                 "(reservation accounting should have prevented this)")
         bid, _ = self._lru.popitem(last=False)
         self._counters["evictions"].inc()
+        self._unregister_cascade(bid)
+        return bid
+
+    def _unregister_cascade(self, bid: int):
+        """Drop ``bid``'s trie registration and every descendant's —
+        their chain keys dangle the moment the parent link goes, so a
+        partial invalidation would leave unreachable-but-stale entries.
+        Cached (refcount-0, LRU-parked) descendants move to the free
+        list; live ones just lose their trie entry."""
         stack = [bid]
         while stack:
             b = stack.pop()
@@ -439,7 +456,58 @@ class BlockManager:
             if b != bid and b in self._lru:
                 del self._lru[b]
                 self._free.append(b)
-        return bid
+
+    def truncate_to(self, slot: int, pos: int):
+        """Roll ``slot``'s chain back to cover exactly positions
+        ``[0, pos)`` — the speculative-decode ROLLBACK hook: after the
+        verify step rejects a draft suffix, the blocks that existed only
+        to hold rejected tokens go back to the pool and the admission
+        reservation is re-credited, so the slot can grow over the same
+        positions again as real decoding proceeds (growth stays
+        infallible).  A no-op when the chain is already within ``pos``.
+
+        Safety invariants, in the order they matter:
+
+          * **trie**: every registered block at chain index >=
+            ``pos // block_len`` is cascade-unregistered BEFORE anything
+            is freed.  The partial block at the cut stays in the chain
+            but will be rewritten in place at positions >= ``pos``, and
+            removed blocks return to the free list for arbitrary reuse —
+            either way, a later prefix lookup must never be served by
+            them (the stale-hit hazard :meth:`_evict_one` also guards).
+            In the engine flow only *generated* positions are ever
+            rolled back, so registered PROMPT blocks sit strictly below
+            the cut and keep serving hits;
+          * **refcounts / COW**: removed blocks are deref'd, not freed
+            outright — a block shared with another slot's chain (COW
+            sharing, adopted prefixes) survives untouched for its other
+            owners and only leaves this chain's table;
+          * **reservation**: each block this slot actually releases is
+            re-credited to its ``reserved_left``, keeping
+            ``blocks_needed``-based admission exact.
+        """
+        st = self._slots[slot]
+        if pos < 0:
+            raise ValueError(f"pos must be >= 0, got {pos}")
+        keep = -(-pos // self.block_len)         # blocks covering [0, pos)
+        cut = pos // self.block_len              # first rewritable block
+        for bid in st.chain[cut:]:
+            if bid in self._block_key:
+                self._unregister_cascade(bid)
+        removed = st.chain[keep:]
+        if not removed:
+            self._refresh_gauges()
+            return
+        del st.chain[keep:]
+        for bid in removed:
+            self._ref[bid] -= 1
+            if self._ref[bid] == 0:
+                # unregistered above, so never LRU-parked: straight back
+                # to the free list
+                self._free.append(bid)
+        st.reserved_left += len(removed)
+        self._reserved += len(removed)
+        self._refresh_gauges()
 
     # -- table export ------------------------------------------------------
 

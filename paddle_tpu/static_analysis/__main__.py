@@ -1,10 +1,12 @@
 """``python -m paddle_tpu.static_analysis`` — lint the serving step.
 
 Builds a tiny-config llama ServingEngine in every cache layout
-(contiguous / paged, wave / chunked admission), runs the graph-lint
-suite over each once-jitted step function via ``engine.lint_step()``
-(one abstract trace per layout — no compile, no device step), and
-prints the findings.  Exit status 0 = clean, 1 = findings.
+(contiguous / paged, wave / chunked admission, plus the
+speculative-decode verify step in both cache layouts and its chunked
+composition), runs the graph-lint suite over each once-jitted step
+function via ``engine.lint_step()`` (one abstract trace per layout — no
+compile, no device step), and prints the findings.  Exit status 0 =
+clean, 1 = findings.
 
 This is the CI smoke for the "zero findings on the serving hot path"
 contract (ISSUE 6 acceptance): the same lint the engines self-run at
@@ -32,6 +34,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="paged block length (default 16)")
     ap.add_argument("--prefill-chunk", type=int, default=8,
                     help="chunked-prefill chunk (default 8)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculative draft window (default 4)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings instead of the report")
     args = ap.parse_args(argv)
@@ -54,6 +58,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         ("paged+chunked",
          dict(paged=True, block_len=args.block_len, chunked=True,
               prefill_chunk=args.prefill_chunk)),
+        # the spec-decode verify step (KV-cache donation must survive
+        # the (s, k+1) window signature) in both cache layouts, plus the
+        # chunked composition
+        ("contiguous+spec",
+         dict(spec_decode=True, spec_k=args.spec_k)),
+        ("paged+spec",
+         dict(paged=True, block_len=args.block_len, spec_decode=True,
+              spec_k=args.spec_k)),
+        ("paged+chunked+spec",
+         dict(paged=True, block_len=args.block_len, chunked=True,
+              prefill_chunk=args.prefill_chunk, spec_decode=True,
+              spec_k=args.spec_k)),
     ]
     total = 0
     blob = {}

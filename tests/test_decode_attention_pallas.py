@@ -122,6 +122,68 @@ def test_chunked_prefill_counts_kernel_path():
     assert fam.value(op="decode_attention_kernel", path="contiguous") >= 1
 
 
+def test_spec_verify_hint_relabels_kernel_path():
+    """ISSUE 7 routing visibility: a verify-window build made under
+    ``kernel_path_hint("spec_verify")`` — the serving engine's
+    spec-decode trace — counts as op="spec_verify" at BOTH dispatch
+    layers (path decision + kernel build), while the math stays exactly
+    the q-tiled kernel's (parity vs the reference on the k+1 window
+    shape, per-row depths)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.ops import _dispatch
+
+    reg = obs.default_registry()
+    b, k_draft = 2, 4
+    q, k, v = _qkv(b, k_draft + 1, 8, 2, 64, 256, seed=11)
+    pos = jnp.asarray([37, 130], jnp.int32)
+    with _dispatch.kernel_path_hint("spec_verify"):
+        got = decode_attention_pallas(q, k, v, pos, block_kv=128,
+                                      interpret=True)
+        decode_attention_path(b, k_draft + 1, 8, 2, 64, 256)
+    want = cached_decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    fam = reg.get("ops.kernel_path")
+    # the kernel build relabelled (k+1 window fits one q tile, so the
+    # un-hinted label would have been decode_attention_kernel)
+    assert fam.value(op="spec_verify", path="contiguous") >= 1
+    # ...and the decode_attention_path decision relabelled too
+    assert sum(c.value() for c in fam.children()
+               if c.labels.get("op") == "spec_verify"
+               and c.labels.get("path") in ("pallas_decode",
+                                            "xla_math")) >= 1
+    # outside the hint, labels revert
+    decode_attention_path(b, 1, 8, 2, 64, 256)
+    assert fam.value(op="decode_attention", path="xla_math",
+                     cache="contiguous") >= 1
+
+
+def test_spec_verify_dispatch_contract():
+    """The verify window rides the chunked-prefill dispatch contract:
+    q-depth k+1 is pallas-eligible wherever a chunk would be (long
+    caches on Pallas backends), falls back below the min-len threshold,
+    and is never rejected for being multi-token."""
+    from paddle_tpu.ops import _dispatch as dsp
+
+    old = flags.flag("decode_attention_min_len")
+    flags.set_flags({"decode_attention_min_len": 4096})
+    orig = dsp.use_pallas
+    dsp.use_pallas = lambda: True
+    try:
+        path, reason = decode_attention_path(8, 5, 8, 2, 64, 8192)
+        assert path == "pallas_decode", reason
+        # paged layout too (one block == one chunk)
+        path, _ = decode_attention_path(8, 5, 8, 2, 64, 8192,
+                                        paged_block_len=128)
+        assert path == "pallas_decode"
+        # below threshold the XLA math path is the design, not a gap
+        path, reason = decode_attention_path(8, 5, 8, 2, 64, 2048)
+        assert path == "xla_math" and "min_len" in reason
+    finally:
+        dsp.use_pallas = orig
+        flags.set_flags({"decode_attention_min_len": old})
+
+
 # -- paged cache: block-table dereference ------------------------------------
 
 def _paged_pool(kc, vc, tables, num_pool, bl):

@@ -516,3 +516,56 @@ def test_prefill_with_cache_routes_through_flash_kernel():
         flags.set_flags({"pallas_interpret": False,
                          "flash_attention_force": False})
     np.testing.assert_array_equal(ref, out)
+
+
+def test_accept_draft_tokens_greedy_prefix():
+    """The spec-decode accept helper (ISSUE 7): greedy rows commit the
+    longest verified prefix + bonus; a mismatch, a masked pad column, or
+    a sampled row all cut acceptance exactly where they should."""
+    from paddle_tpu.models.generation import accept_draft_tokens
+
+    v = 8
+
+    def one_hot_logits(rows):
+        # rows: (B, S) of argmax targets → (B, S, V) logits
+        out = np.full((len(rows), len(rows[0]), v), -5.0, np.float32)
+        for b, r in enumerate(rows):
+            for s, t in enumerate(r):
+                out[b, s, t] = 5.0
+        return jnp.asarray(out)
+
+    # model's argmax stream per position; drafts to verify against
+    logits = one_hot_logits([[3, 4, 5],     # full accept
+                             [3, 7, 5],     # draft 2 mismatches
+                             [0, 4, 5],     # pad-id argmax, masked column
+                             [3, 4, 5]])    # sampled row
+    drafts = jnp.asarray([[3, 4],
+                          [3, 4],           # pos0 argmax 3 == d1, pos1
+                                            # argmax 7 != d2 → n = 2
+                          [0, 4],           # d1 == 0 but MASKED → n = 1
+                          [3, 4]], jnp.int32)
+    mask = jnp.asarray([[True, True],
+                        [True, True],
+                        [False, True],
+                        [True, True]])
+    temps = jnp.asarray([0.0, 0.0, 0.0, 0.9], jnp.float32)
+    topk = jnp.zeros((4,), jnp.int32)
+    topp = jnp.ones((4,), jnp.float32)
+    toks, n = accept_draft_tokens(logits, drafts, mask,
+                                  jax.random.key(0), temps, topk, topp)
+    assert list(np.asarray(n)) == [3, 2, 1, 1]
+    toks = np.asarray(toks)
+    assert list(toks[0]) == [3, 4, 5]
+    assert list(toks[1]) == [3, 7, 0]       # past-n columns are pad (0)
+    assert list(toks[2]) == [0, 0, 0]       # argmax==pad: committed via
+                                            # n=1, suffix padded
+    assert int(n[3]) == 1                   # sampled row: plain decode
+
+    # static greedy knobs behave like the traced-greedy row
+    toks2, n2 = accept_draft_tokens(logits[:1], drafts[:1], mask[:1],
+                                    jax.random.key(0), 0.0)
+    assert int(n2[0]) == 3 and list(np.asarray(toks2)[0]) == [3, 4, 5]
+    # static sampled knobs: accept exactly one
+    _, n3 = accept_draft_tokens(logits[:1], drafts[:1], mask[:1],
+                                jax.random.key(0), 1.0)
+    assert int(n3[0]) == 1

@@ -136,6 +136,102 @@ def test_prefix_cache_disabled_frees_immediately():
     assert m.stats["prefix_lookups"] == 0
 
 
+def test_truncate_to_frees_blocks_and_recredits_reservation():
+    """The spec-decode rollback hook: blocks past the cut return to the
+    pool and the reservation is re-credited, so the slot can grow over
+    the same positions again — and the reservation ceiling still holds
+    exactly afterwards."""
+    m = _mgr()
+    m.admit(0, _toks(6, 1), 6, 10)               # needs ceil(16/4) = 4
+    m.ensure_capacity(0, 11)                     # draft window: 3 blocks
+    assert len(m.chain(0)) == 3
+    free_before = m.free_blocks()
+    m.truncate_to(0, 7)                          # keep positions [0, 7)
+    assert len(m.chain(0)) == 2
+    assert m.free_blocks() == free_before + 1
+    # re-credited: the slot can grow back over the rolled-back span...
+    assert m.ensure_capacity(0, 11) is True
+    assert m.ensure_capacity(0, 15) is True      # 4 blocks = the ceiling
+    # ...but the admission ceiling is still exact
+    with pytest.raises(RuntimeError, match="reservation"):
+        m.ensure_capacity(0, 16)
+
+
+def test_truncate_to_noop_within_chain():
+    m = _mgr()
+    m.admit(0, _toks(6, 2), 6, 4)
+    chain = m.chain(0)
+    m.truncate_to(0, len(chain) * 4)             # covers the whole chain
+    assert m.chain(0) == chain
+
+
+def test_truncate_inside_shared_cow_block():
+    """Truncation cutting INSIDE a block shared with another chain:
+    the shared block stays (deref'd only where removed), the owner's
+    chain is untouched, and a later write into the kept shared block
+    still goes through the COW guard."""
+    m = _mgr(num_blocks=17, block_len=4)
+    p = _toks(8, 9)
+    m.admit(0, p, 8, 4)                          # registers blocks 0, 1
+    m.admit(1, p + _toks(5, 10, lo=200, hi=300), 13, 4)  # adopts both
+    assert m.chain(1)[:2] == m.chain(0)[:2]
+    shared = m.chain(1)[1]
+    owner_chain = m.chain(0)
+    m.truncate_to(1, 6)                          # cut inside shared block 1
+    assert m.chain(1) == owner_chain[:2]         # shared tail kept, own gone
+    assert m.chain(0) == owner_chain             # owner untouched
+    # the kept shared block is still refcounted by both chains: a write
+    # at position >= 6 must COW-privatise it
+    cow = m.ensure_writable(1, 1)
+    assert cow is not None and cow[0] == shared
+    assert m.chain(0)[1] == shared
+
+
+def test_truncate_trie_entries_past_cut_never_hit():
+    """Registered blocks at/past the cut are cascade-unregistered: the
+    partial block at the cut will be rewritten in place and removed
+    blocks go back to the pool — neither may serve a prefix hit
+    afterwards (blocks strictly below the cut keep serving)."""
+    m = _mgr(num_blocks=17, block_len=4)
+    p = _toks(12, 11)                            # 3 full blocks
+    m.admit(0, p, 12, 8)
+    m.truncate_to(0, 6)                          # cut inside block 1
+    m.release(0)
+    # a same-prompt admission may adopt block 0 (below the cut) but
+    # NEITHER block 1 (unregistered partial at the cut) nor block 2
+    got = m.admit(1, p, 12, 4)
+    assert got == 4
+    m.release(1)
+    # prefix-cache bookkeeping stayed consistent: full wipe re-registers
+    m2 = _mgr(num_blocks=17, block_len=4)
+    m2.admit(0, p, 12, 8)
+    m2.truncate_to(0, 0)                         # roll the whole chain back
+    assert m2.chain(0) == []
+    m2.release(0)
+    assert m2.admit(1, p, 12, 4) == 0            # nothing survived the cut
+
+
+def test_truncate_then_eviction_stays_consistent():
+    """Eviction after truncation: kept registered blocks park on the LRU
+    at release and evict cleanly; truncated-away blocks are already free
+    and never dangle in the trie."""
+    m = _mgr(num_blocks=6, block_len=4)          # 5 usable
+    p = _toks(8, 12)
+    m.admit(0, p, 8, 8)                          # reserves 4
+    m.ensure_capacity(0, 11)                     # 3 blocks live
+    m.truncate_to(0, 8)                          # drop the draft block
+    m.release(0)
+    assert m.cached_blocks() == 2                # both prompt blocks parked
+    q = _toks(16, 13, lo=200, hi=300)
+    assert m.admit(1, q, 16, 4) == 0             # needs 5: forces eviction
+    assert m.stats["evictions"] >= 1
+    m.release(1)
+    assert m.admit(2, p, 8, 4) == 0              # evicted chain never hits
+    assert m.blocks_in_use() > 0
+    m.release(2)
+    assert m.blocks_in_use() == 0
+
+
 def test_peak_counter_and_needed():
     m = _mgr(num_blocks=17, block_len=4)
     assert m.blocks_needed(6, 4) == 3

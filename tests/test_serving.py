@@ -336,6 +336,204 @@ def test_chunked_idle_step_skips_device_dispatch(lm):
     assert dict(eng.drain())[rid] == _reference(lm, p, 2)
 
 
+class _ScriptedDrafter:
+    """Test drafter: proposes each request's KNOWN greedy continuation
+    (so windows verify fully), optionally corrupting the draft at a
+    fixed offset (forcing a mid-window rejection + rollback at a
+    deterministic point).  ``refs``: [(prompt, ref_stream)]."""
+
+    def __init__(self, refs, k, corrupt_at=None, vocab=None):
+        self.refs = sorted(refs, key=lambda pr: -len(pr[0]))
+        self.k, self.corrupt_at, self.vocab = k, corrupt_at, vocab
+
+    def propose(self, history):
+        hist = [int(t) for t in history]
+        for p, ref in self.refs:
+            lp = len(p)
+            if hist[:lp] == [int(t) for t in p]:
+                g = len(hist) - lp            # generated so far
+                prop = list(ref[g:g + self.k])
+                if self.corrupt_at is not None \
+                        and self.corrupt_at < len(prop):
+                    prop[self.corrupt_at] = (
+                        (prop[self.corrupt_at] + 1) % self.vocab)
+                return np.asarray(prop, np.int32)
+        return np.zeros((0,), np.int32)
+
+
+def test_spec_decode_parity_staggered(lm):
+    """ISSUE 7 acceptance (contiguous): the spec engine's greedy outputs
+    are token-identical to the plain engine's on the staggered trace —
+    with the real n-gram self-drafter proposing (and the model
+    rejecting some of it: real rollbacks) — and the verify step
+    compiled exactly once under the armed watchdog."""
+    long_p = _prompt(40, seed=70)
+    shorts = [_prompt(n, seed=71 + i) for i, n in enumerate((5, 7, 6, 9))]
+    plain = ServingEngine(lm, num_slots=3, max_length=MAXLEN)
+    rp, outp = _staggered_trace(plain, long_p, shorts)
+    spec = ServingEngine(lm, num_slots=3, max_length=MAXLEN,
+                         spec_decode=True, spec_k=4)
+    rs, outs = _staggered_trace(spec, long_p, shorts)
+    assert spec.step_traces == 1, (
+        f"verify step retraced: {spec.step_traces} traces")
+    for a, b in zip(rp, rs):
+        assert outp[a] == outs[b], (outp[a], outs[b])
+    m = spec.metrics()["spec"]
+    assert m["drafted_tokens"] > 0            # the drafter really fired
+    # committed-token accounting: tok counters move by COMMITTED tokens
+    assert int(spec._m_tokens.value()) == sum(
+        len(outs[r]) for r in rs)
+
+
+def test_spec_chunked_parity_staggered(lm):
+    """spec × chunked (contiguous): the mixed verify step matches the
+    wave engine token for token while a long prompt streams in chunks —
+    one compiled program, prefill suspended rows drafting nothing."""
+    long_p = _prompt(40, seed=70)
+    shorts = [_prompt(n, seed=71 + i) for i, n in enumerate((5, 7, 6, 9))]
+    wave = ServingEngine(lm, num_slots=3, max_length=MAXLEN)
+    rw, outw = _staggered_trace(wave, long_p, shorts)
+    ck = ServingEngine(lm, num_slots=3, max_length=MAXLEN, chunked=True,
+                       prefill_chunk=8, spec_decode=True, spec_k=3)
+    rc, outc = _staggered_trace(ck, long_p, shorts)
+    assert ck.step_traces == 1
+    assert ck.prefill_traces == 0
+    for a, b in zip(rw, rc):
+        assert outw[a] == outc[b], (outw[a], outc[b])
+    assert ck.metrics()["spec"]["drafted_tokens"] > 0
+
+
+def test_spec_forced_midwindow_rejection_rolls_back(lm):
+    """A drafter scripted to corrupt draft #3 forces a rejection INSIDE
+    every window: rows must commit exactly the verified prefix (3
+    tokens: 2 verified drafts + the bonus), roll back the rest, and the
+    stream must stay token-identical to plain greedy decode."""
+    p = _prompt(6, seed=140)
+    ref = _reference(lm, p, 12)
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        spec_decode=True, spec_k=4)
+    eng._drafter = _ScriptedDrafter([(p, ref)], k=4, corrupt_at=2,
+                                    vocab=lm.config.vocab_size)
+    rid = eng.submit(p, max_new_tokens=12)
+    out = dict(eng.drain())
+    assert out[rid] == ref
+    m = eng.metrics()["spec"]
+    assert m["rollbacks"] >= 2                # every full window rejected
+    assert m["draft_miss_tokens"] >= 2
+    # the accepted-per-step histogram saw the 3-token commits
+    bc = eng._m_spec_accept.bucket_counts()
+    assert bc["3"] - bc["2"] >= 1             # cumulative → per-bucket
+
+
+def test_spec_eos_inside_accepted_window(lm):
+    """EOS landing mid-window: the row must stop AT the EOS (tokens
+    after it in the verified window are discarded), retire with reason
+    'eos', and match the EOS-truncated reference exactly."""
+    p0 = eos = cut = None
+    for seed in range(31, 80):
+        cand = _prompt(5, seed=seed)
+        ref = _reference(lm, cand, 10)
+        firsts = [j for j, t in enumerate(ref) if ref.index(t) == j]
+        mid = [j for j in firsts if 2 <= j <= 4]
+        if mid:
+            p0, cut = cand, mid[0]
+            eos = ref[cut]
+            break
+    assert p0 is not None, "no probe prompt produced a mid-stream token"
+    ref = _reference(lm, p0, 10, eos=eos)
+    eng = ServingEngine(lm, num_slots=1, max_length=MAXLEN,
+                        eos_token_id=eos, spec_decode=True, spec_k=4)
+    eng._drafter = _ScriptedDrafter([(p0, _reference(lm, p0, 10))], k=4)
+    rid = eng.submit(p0, max_new_tokens=10)
+    out = dict(eng.drain())
+    assert out[rid] == ref
+    assert out[rid][-1] == eos and len(out[rid]) == cut + 1
+    reg = __import__("paddle_tpu").observability.default_registry()
+    assert reg.get("serving.retired").value(engine=eng._eid,
+                                            reason="eos") == 1
+    # the retiring step really committed a multi-token window
+    assert eng._m_spec_accept.sum >= eng._m_spec_accept.count + 1
+
+
+def test_spec_multi_token_accounting_counts_once(lm):
+    """ISSUE 7 satellite (queue/metrics audit): an N-token accept is N
+    tokens in ONE step — tokens_generated moves by N, the accept
+    histogram absorbs one observation of N (its SUM equals committed
+    tokens), TPOT stays one observation per retired request, and
+    queue-wait one per admission."""
+    prompts = [_prompt(5, seed=160), _prompt(8, seed=161)]
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        spec_decode=True, spec_k=4)
+    refs = [(p, _reference(lm, p, 6)) for p in prompts]
+    eng._drafter = _ScriptedDrafter(refs, k=4)   # multi-token accepts
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    out = dict(eng.drain())
+    total = sum(len(out[r]) for r in rids)
+    assert total == 12
+    assert int(eng._m_tokens.value()) == total
+    assert int(eng._m_spec_accept.sum) == total - 2   # prefill tokens
+    assert eng._m_spec_accept.count < total - 2       # ⇒ multi-accepts
+    assert eng._m_tpot.count == len(rids)             # once per request
+    assert eng._m_queue_wait.count == len(rids)
+    reg = __import__("paddle_tpu").observability.default_registry()
+    fam = reg.get("serving.retired")
+    retired = sum(c.value() for c in fam.children()
+                  if c.labels.get("engine") == eng._eid)
+    assert retired == len(rids)
+    # one step-latency observation per VERIFY tick (prefill waves bump
+    # _ticks but are not decode steps)
+    assert eng._m_step_ms.count == eng._ticks - int(eng._m_waves.value())
+    # draft/verify spans were emitted (serving.spec instrumentation)
+    names = {e["name"] for e in
+             __import__("paddle_tpu").observability.get_tracer().events()}
+    assert "serving.draft" in names and "serving.verify" in names
+
+
+def test_spec_sampled_rows_ride_along(lm):
+    """A sampled request next to greedy ones in spec mode: greedy rows
+    keep exact parity (and keep speculating); the sampled row decodes
+    one exact-distribution token per step."""
+    g0, s0 = _prompt(5, seed=51), _prompt(6, seed=53)
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN, seed=3,
+                        spec_decode=True, spec_k=4)
+    rg = eng.submit(g0, max_new_tokens=6)
+    rs = eng.submit(s0, max_new_tokens=6,
+                    sampling=SamplingParams(temperature=0.9, top_k=8,
+                                            top_p=0.95))
+    results = dict(eng.drain())
+    assert eng.step_traces == 1
+    assert results[rg] == _reference(lm, g0, 6)
+    assert len(results[rs]) == 6
+    assert all(0 <= t < lm.config.vocab_size for t in results[rs])
+
+
+def test_ngram_drafter_units():
+    """The prompt-lookup proposer: longest-n-gram-first, most recent
+    prior occurrence, k-cap, and honest empty-handedness."""
+    from paddle_tpu.serving import NgramDrafter
+
+    d = NgramDrafter(4, max_ngram=3)
+    # tail [7, 8] occurred earlier; the 4 tokens after it are proposed
+    h = [1, 7, 8, 9, 2, 3, 5, 7, 8]
+    assert list(d.propose(h)) == [9, 2, 3, 5]
+    # most RECENT occurrence wins (tail [5] matched at its later site)
+    assert list(NgramDrafter(2, max_ngram=1).propose(
+        [5, 1, 5, 2, 5])) == [2, 5]
+    # longer n-gram beats shorter: [3, 5] over the later bare [5]
+    assert list(NgramDrafter(2, max_ngram=3).propose(
+        [3, 5, 9, 9, 5, 4, 3, 5])) == [9, 9]
+    # proposal truncated by history end, never fabricated
+    assert list(NgramDrafter(4, max_ngram=2).propose(
+        [4, 6, 1, 4, 6])) == [1, 4, 6]
+    # no recurring n-gram → no proposal
+    assert NgramDrafter(4).propose([1, 2, 3, 4, 5]).size == 0
+    assert NgramDrafter(4).propose([9]).size == 0
+    with pytest.raises(ValueError, match="k must be"):
+        NgramDrafter(0)
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramDrafter(2, max_ngram=0)
+
+
 def test_per_row_position_decode_matches_scalar(lm):
     """The serving-enabling primitive: decode_step with a per-row
     position VECTOR must equal per-row scalar decode_steps."""

@@ -233,6 +233,160 @@ def test_paged_chunked_parity_with_shared_prompt(lm):
     assert outc[rc[2]] == _reference(lm, long_p, 6)
 
 
+class _ScriptedDrafter:
+    """Drafts each request's KNOWN greedy continuation (optionally
+    corrupted at a fixed offset) — deterministic full-accept and
+    mid-window-rejection traces.  Mirrors test_serving.py's."""
+
+    def __init__(self, refs, k, corrupt_at=None, vocab=None):
+        self.refs = sorted(refs, key=lambda pr: -len(pr[0]))
+        self.k, self.corrupt_at, self.vocab = k, corrupt_at, vocab
+
+    def propose(self, history):
+        hist = [int(t) for t in history]
+        for p, ref in self.refs:
+            lp = len(p)
+            if hist[:lp] == [int(t) for t in p]:
+                g = len(hist) - lp
+                prop = list(ref[g:g + self.k])
+                if self.corrupt_at is not None \
+                        and self.corrupt_at < len(prop):
+                    prop[self.corrupt_at] = (
+                        (prop[self.corrupt_at] + 1) % self.vocab)
+                return np.asarray(prop, np.int32)
+        return np.zeros((0,), np.int32)
+
+
+def test_paged_spec_parity_staggered_with_shared_prompt(lm):
+    """ISSUE 7 acceptance (paged): the spec engine over the block pool
+    is token-identical to the paged plain engine on a staggered trace
+    WITH a shared system prompt — prefix adoption, draft-window block
+    growth and rollback truncation all riding the once-jitted verify
+    step (armed watchdog: one trace)."""
+    sys_p = _prompt(17, seed=100)
+    prompts = [np.concatenate([sys_p, _prompt(4, 101)]),
+               _prompt(9, 102),
+               np.concatenate([sys_p, _prompt(6, 103)]),
+               _prompt(12, 104)]
+
+    def trace(eng):
+        # 12-token streams give the self-drafter generated history to
+        # match against (tiny random models cycle), so real proposals —
+        # and real rejections — ride this trace
+        rids = [eng.submit(prompts[0], max_new_tokens=12),
+                eng.submit(prompts[1], max_new_tokens=12)]
+        eng.step()
+        eng.step()
+        rids.append(eng.submit(prompts[2], max_new_tokens=12))
+        eng.step()
+        rids.append(eng.submit(prompts[3], max_new_tokens=8))
+        return rids, dict(eng.drain())
+
+    plain = _paged(lm)
+    rp, outp = trace(plain)
+    spec = _paged(lm, spec_decode=True, spec_k=4)
+    rs, outs = trace(spec)
+    assert spec.step_traces == 1, (
+        f"paged verify step retraced: {spec.step_traces} traces")
+    for a, b in zip(rp, rs):
+        assert outp[a] == outs[b], (outp[a], outs[b])
+    # prefix sharing still fired under spec admission
+    assert spec.kv.stats["prefix_hit_tokens"] == 16
+    assert spec.metrics()["spec"]["drafted_tokens"] > 0
+    # every chain released: rollback truncation never leaked a block
+    assert spec.kv.blocks_in_use() == 0
+
+
+def test_paged_spec_rollback_truncates_draft_blocks(lm):
+    """A corrupted drafter forces a rejection in every window: the
+    chain grown for the draft span must be truncated back (blocks
+    returned, reservation re-credited — the engine would otherwise blow
+    its reservation re-growing), outputs exact."""
+    p = _prompt(6, seed=140)
+    ref = _reference(lm, p, 12)
+    eng = _paged(lm, num_slots=1, spec_decode=True, spec_k=4)
+    eng._drafter = _ScriptedDrafter([(p, ref)], k=4, corrupt_at=2,
+                                    vocab=lm.config.vocab_size)
+    rid = eng.submit(p, max_new_tokens=12)
+    out = dict(eng.drain())
+    assert out[rid] == ref
+    m = eng.metrics()["spec"]
+    assert m["rollbacks"] >= 2
+    assert eng.kv.blocks_in_use() == 0
+
+
+def test_paged_spec_eos_inside_window_frees_blocks(lm):
+    """EOS mid-window in the paged engine: retirement at the EOS (the
+    verified-but-discarded suffix rolled back via truncate_to) and the
+    slot's whole chain released to the pool."""
+    p0 = eos = cut = None
+    for seed in range(31, 80):
+        cand = _prompt(5, seed=seed)
+        ref = _reference(lm, cand, 10)
+        firsts = [j for j, t in enumerate(ref) if ref.index(t) == j]
+        mid = [j for j in firsts if 2 <= j <= 4]
+        if mid:
+            p0, cut = cand, mid[0]
+            eos = ref[cut]
+            break
+    assert p0 is not None
+    eng = _paged(lm, num_slots=1, eos_token_id=eos, spec_decode=True,
+                 spec_k=4)
+    eng._drafter = _ScriptedDrafter([(p0, _reference(lm, p0, 10))], k=4)
+    rid = eng.submit(p0, max_new_tokens=10)
+    out = dict(eng.drain())
+    assert out[rid] == _reference(lm, p0, 10, eos=eos)
+    assert out[rid][-1] == eos and len(out[rid]) == cut + 1
+    assert eng.kv.blocks_in_use() == 0
+    # the EOS step really was a multi-token accept
+    assert eng._m_spec_accept.sum >= eng._m_spec_accept.count + 1
+
+
+def test_paged_spec_tight_pool_stays_correct(lm):
+    """Spec decoding under pool pressure: draft-window growth stays
+    inside each slot's reservation (truncation re-credits it), eviction
+    churn proceeds, outputs match the reference."""
+    prompts = [_prompt(10, seed=120 + i) for i in range(5)]
+    eng = _paged(lm, num_slots=3, num_blocks=7, spec_decode=True,
+                 spec_k=4)
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    results = dict(eng.drain())
+    for rid, p in zip(rids, prompts):
+        assert results[rid] == _reference(lm, p, 6)
+    assert eng.kv.blocks_in_use() == 0
+
+
+def test_paged_chunked_spec_parity_with_shared_prompt(lm):
+    """All three compose: paged pool + chunked prefill + speculative
+    decode, token-identical to the paged wave engine on the shared-
+    prompt staggered trace, one compiled mixed verify step."""
+    sys_p = _prompt(17, seed=200)
+    long_p = np.concatenate([sys_p, _prompt(23, 201)])
+    p_shared = np.concatenate([sys_p, _prompt(5, 202)])
+    shorts = [_prompt(6, 203), _prompt(9, 204)]
+
+    def trace(eng):
+        rids = [eng.submit(shorts[0], max_new_tokens=10),
+                eng.submit(shorts[1], max_new_tokens=10)]
+        eng.step()
+        eng.step()
+        rids.append(eng.submit(long_p, max_new_tokens=6))
+        eng.step()
+        rids.append(eng.submit(p_shared, max_new_tokens=8))
+        return rids, dict(eng.drain())
+
+    wave = _paged(lm)
+    rw, outw = trace(wave)
+    ck = _paged(lm, chunked=True, prefill_chunk=8, spec_decode=True,
+                spec_k=3)
+    rc, outc = trace(ck)
+    assert ck.step_traces == 1
+    for a, b in zip(rw, rc):
+        assert outw[a] == outc[b], (outw[a], outc[b])
+    assert ck.kv.stats["prefix_hit_tokens"] >= 16
+    assert ck.metrics()["spec"]["drafted_tokens"] > 0
+
+
 def test_paged_chunked_tight_pool_blocks_admission_not_correctness(lm):
     """Chunked admission under pool pressure: the reservation check
     defers the FIFO head until retirements free blocks, and outputs stay

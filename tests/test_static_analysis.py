@@ -261,11 +261,51 @@ def test_serving_engine_lints_clean_armed(lm, paged, chunked):
         flags.set_flags({"graph_lint": old})
 
 
+@pytest.mark.parametrize("paged", [False, True])
+def test_donation_rule_covers_spec_decode_step(lm, paged):
+    """ISSUE 7 satellite: the speculative verify step's new signature —
+    a (num_slots, k+1) window matrix and a (num_slots, k) draft mask in
+    place of the token vector — must not lose the KV-cache donation.
+    Offender: the raw impl traced without donate_argnums double-buffers
+    the cache (finding sized at exactly cache bytes).  Clean: the
+    engine's tracked step lints to zero findings."""
+    kw = dict(paged=True, block_len=16) if paged else {}
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        spec_decode=True, spec_k=4, **kw)
+    raw = eng._step_fn.python_fn
+    found = _only(sa.analyze(raw, *eng._lint_args()), "donation")
+    assert found, "un-donated spec verify step must be flagged"
+    assert found[0].bytes == eng.cache_hbm_bytes
+    assert eng.lint_step() == []
+
+
+def test_spec_engine_lints_clean_armed(lm):
+    """Armed first-tick self-lint over a REAL spec-decode run (drafts
+    proposed, verified, rolled back) finds nothing, and the budget-1
+    trace contract holds."""
+    old = flags.flag("graph_lint")
+    flags.set_flags({"graph_lint": "raise"})
+    try:
+        eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                            spec_decode=True, spec_k=3)
+        prompt = np.random.RandomState(5).randint(0, 256, 6).astype(
+            np.int32)
+        rid = eng.submit(prompt, max_new_tokens=5)
+        out = dict(eng.drain())
+        assert len(out[rid]) == 5
+        assert eng._linted
+        assert eng.step_traces == 1
+    finally:
+        flags.set_flags({"graph_lint": old})
+
+
 def test_cli_reports_zero_findings():
     """`python -m paddle_tpu.static_analysis` (in-process): zero
-    findings on the tiny-config engine step in both cache layouts,
-    exit status 0."""
+    findings on the tiny-config engine step in every layout — both
+    cache layouts, chunked, and the spec-decode verify steps — exit
+    status 0."""
     from paddle_tpu.static_analysis.__main__ import main
 
     assert main(["--slots", "2", "--max-length", "64",
-                 "--block-len", "16", "--prefill-chunk", "8"]) == 0
+                 "--block-len", "16", "--prefill-chunk", "8",
+                 "--spec-k", "4"]) == 0
