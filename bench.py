@@ -773,6 +773,27 @@ def _cache_hbm_row(eng):
             "graph_lint_findings": len(eng.lint_step())}
 
 
+def _mesh_preflight_row(eng, mesh="mp2dp2"):
+    """Mesh pre-flight snapshot (ISSUE 8, BASELINE.md "Mesh pre-flight
+    conventions"): the engine's once-jitted step linted under its
+    DECLARED mp2dp2 shardings — an abstract mesh, so this runs on any
+    host — with the per-axis predicted collective bytes per step, the
+    predicted peak HBM per device, and the cache cross-check.  findings
+    must be 0: the serving layouts are pre-validated for the ROADMAP
+    item-1 mesh deployment before any multi-chip compile exists."""
+    pf = eng.mesh_preflight(mesh)
+    return {"mesh": pf["mesh"],
+            "findings": len(pf["findings"]),
+            "comm_bytes_per_step_per_axis": {
+                a: row["bytes_per_step"]
+                for a, row in pf["comm"]["per_axis"].items()},
+            "predicted_peak_hbm_bytes_per_device":
+                pf["hbm"]["peak_bytes_per_device"],
+            "predicted_cache_bytes_per_device":
+                pf["hbm"]["cache_bytes_per_device"],
+            "cache_check": pf["cache_check"]}
+
+
 def _serving_bench(model, on_tpu):
     """Continuous-batching engine under a Poisson-ish synthetic arrival
     trace (paddle_tpu/serving): exponential inter-arrival gaps measured
@@ -837,6 +858,10 @@ def _serving_bench(model, on_tpu):
            # resident instead of the 2x an un-donated carry pins — the
            # graph-lint donation rule guards the 1x
            "cache_hbm": _cache_hbm_row(eng),
+           # mesh pre-flight (ISSUE 8): the same step, pre-validated
+           # for the mp2dp2 deployment it will run under when ROADMAP
+           # item 1 lands — predicted comm + per-device HBM, 0 findings
+           "mesh_preflight": _mesh_preflight_row(eng),
            # SLO snapshot straight from the observability registry (the
            # engine's own series; BASELINE.md conventions) — TTFT/TPOT/
            # queue-wait percentiles span BOTH passes, so the warm pass's
@@ -1014,6 +1039,7 @@ def _paged_serving_bench(model, on_tpu):
             "step_traces": eng.step_traces,
             "prefill_traces": eng.prefill_traces,
             "cache_hbm": _cache_hbm_row(eng),
+            "mesh_preflight": _mesh_preflight_row(eng),
             # registry snapshot: percentiles + the pool's cache
             # accounting (metrics.kv_cache.prefix_hit_rate uses admitted
             # prompt tokens as denominator, so it matches the

@@ -23,29 +23,26 @@ residual risk lives in *control flow*:
     ranks issue different collective counts.  Body collectives under a
     rank-uniform predicate are legitimate and pass.
 
-This lint walks the traced jaxpr (through pjit/shard_map/scan/cond/while/
-remat sub-jaxprs), extracts the ordered collective schedule, and raises
-:class:`CollectiveOrderError` on those two patterns.  The schedule itself
-is returned so callers can pin it in tests (a collective-order regression
-is then a visible diff, the reference's "log the NCCL op sequence"
-debugging technique made structural).
+As of ISSUE 8 the walk itself lives in
+:mod:`paddle_tpu.static_analysis.mesh_rules` as the
+``collective-deadlock`` rule (:func:`~paddle_tpu.static_analysis
+.mesh_rules.walk_collectives`), where it runs mesh-wide alongside the
+sharding-propagation rules; this module is the original API kept as a
+thin shim — same :class:`CollectiveOrderError`, same schedule format,
+same violation strings — so every existing caller and test is
+untouched.  The schedule is still returned so callers can pin it in
+tests (a collective-order regression is then a visible diff, the
+reference's "log the NCCL op sequence" debugging technique made
+structural).
 
 ``FLAGS_collective_lint`` makes every ``build_train_step`` product run
 this lint at its first call (the earliest point batch shapes exist) —
-one abstract trace, nothing per step after.  The dryrun and the pair
-tests also invoke it directly.
-
-The jaxpr plumbing this rule pioneered — sub-jaxpr discovery, the
-rename-tolerant primitive canonicalisation, the 0.4.x shard_map
-rep-rule fallbacks — now lives in :mod:`paddle_tpu.static_analysis.core`
-(ISSUE 6): this module is the shared walker's first client, alongside
-the graph-lint rules (donation / dtype / const-capture / host-sync /
-retrace-hazard) that generalized it into a static-analysis layer.
+one abstract trace, nothing per step after.
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+from typing import List
 
 import jax
 
@@ -53,104 +50,23 @@ from ..static_analysis.core import (CANONICAL as _CANONICAL,
                                     install_rep_rule_fallbacks
                                     as _install_rep_rule_fallbacks,
                                     sub_jaxprs as _sub_jaxprs)
+from ..static_analysis.mesh_rules import (COLLECTIVE_PRIMS
+                                          as _COLLECTIVE_PRIMS,
+                                          collective_sig as _sig,
+                                          walk_collectives
+                                          as _walk_collectives)
 
 __all__ = ["CollectiveOrderError", "collective_schedule",
-           "check_collective_order"]
-
-# primitive names that lower to cross-replica communication.  jax renames
-# these across versions — the lint matches through the shared _CANONICAL
-# table (static_analysis/core.py) instead of pinning one release's
-# strings.  The replication *casts* ("pbroadcast" on 0.4.x, "pvary" on
-# vma jax) move no data and are deliberately absent.
-_COLLECTIVE_PRIMS = {
-    "psum", "psum_invariant", "pmax", "pmin", "all_gather",
-    "all_to_all", "ppermute", "reduce_scatter", "psum_scatter", "pgather",
-}
-_COLLECTIVE_PRIMS |= set(_CANONICAL)
-
-# params that (a) are not sub-jaxprs and (b) identify the collective
-_ID_PARAMS = ("axes", "axis_name", "axis_index_groups", "perm",
-              "all_gather_dimension", "scatter_dimension", "split_axis",
-              "concat_axis", "tiled")
+           "check_collective_order", "check_collectives"]
 
 
 class CollectiveOrderError(RuntimeError):
     """A collective schedule that can diverge across ranks."""
 
 
-def _sig(eqn) -> Tuple:
-    params = {k: v for k, v in eqn.params.items() if k in _ID_PARAMS}
-    shapes = tuple(getattr(v.aval, "shape", ()) for v in eqn.invars)
-    name = _CANONICAL.get(eqn.primitive.name, eqn.primitive.name)
-    return (name, tuple(sorted(
-        (k, str(v)) for k, v in params.items())), shapes)
-
-
 # imported for effect at this module's historical call point (idempotent;
 # static_analysis.core also installs at its own import)
 _install_rep_rule_fallbacks()
-
-
-def _walk(jaxpr, path: str, schedule: List, violations: List) -> None:
-    for eqn in jaxpr.eqns:
-        name = eqn.primitive.name
-        if name in _COLLECTIVE_PRIMS:
-            schedule.append((path, _sig(eqn)))
-            continue
-        if name == "cond":
-            # every branch must issue the SAME collective sequence: the
-            # predicate may be rank-divergent, so any difference is a
-            # potential cross-rank deadlock
-            branch_scheds = []
-            for i, (_, sub) in enumerate(_sub_jaxprs(eqn)):
-                s: List = []
-                _walk(sub, f"{path}/cond.branch{i}", s, violations)
-                branch_scheds.append([sig for _, sig in s])
-                schedule.extend(s)
-            if len({tuple(map(repr, b)) for b in branch_scheds}) > 1:
-                violations.append(
-                    f"{path}: lax.cond branches issue different collective "
-                    f"sequences {branch_scheds} — deadlocks if the "
-                    "predicate diverges across ranks")
-            continue
-        if name == "while":
-            body_colls: List = []
-            cond_rank_divergent = False
-            for k, sub in _sub_jaxprs(eqn):
-                s: List = []
-                _walk(sub, f"{path}/while.{k}", s, violations)
-                schedule.extend(s)
-                if k == "cond_jaxpr":
-                    if s:
-                        violations.append(
-                            f"{path}: collective inside a while_loop "
-                            f"predicate ({[sig[0] for _, sig in s]}) — "
-                            "ranks can disagree on the final (failing) "
-                            "evaluation")
-                    if _uses_axis_index(sub):
-                        cond_rank_divergent = True
-                else:
-                    body_colls.extend(s)
-            if cond_rank_divergent and body_colls:
-                violations.append(
-                    f"{path}: while_loop predicate reads axis_index (a "
-                    "rank-divergent trip count) with collectives in the "
-                    f"body ({[sig[0] for _, sig in body_colls]}) — ranks "
-                    "issue different collective counts")
-            continue
-        # transparent containers: pjit, shard_map, scan, remat, custom_*…
-        for _, sub in _sub_jaxprs(eqn):
-            _walk(sub, f"{path}/{name}", schedule, violations)
-
-
-def _uses_axis_index(jaxpr) -> bool:
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "axis_index":
-            return True
-        for _, sub in _sub_jaxprs(eqn):
-            if _uses_axis_index(sub):
-                return True
-    return False
 
 
 def collective_schedule(fn, *args, **kwargs):
@@ -160,10 +76,9 @@ def collective_schedule(fn, *args, **kwargs):
     order — identical for every rank on the straight-line path.
     """
     jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
-    schedule: List = []
-    violations: List = []
-    _walk(jaxpr.jaxpr, "", schedule, violations)
-    return schedule, violations
+    schedule, violations = _walk_collectives(jaxpr.jaxpr)
+    msgs: List[str] = [f"{path}: {msg}" for path, msg in violations]
+    return schedule, msgs
 
 
 def check_collective_order(fn, *args, **kwargs):
@@ -173,3 +88,8 @@ def check_collective_order(fn, *args, **kwargs):
     if violations:
         raise CollectiveOrderError("\n".join(violations))
     return schedule
+
+
+# reference-parity alias (the upstream sanitizer surface this shim
+# preserves predates the Finding-based rule)
+check_collectives = check_collective_order

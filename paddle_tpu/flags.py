@@ -230,6 +230,28 @@ DEFINE("graph_lint_const_bytes", 1 << 20,
        "least this big are findings (weights closed over instead of "
        "passed as args cost HBM alongside the live copy and retrace on "
        "update); tiny eps/table consts stay below it")
+# mesh pre-flight (paddle_tpu/static_analysis/mesh_rules.py): sharding
+# propagation + collective cost + per-device HBM liveness over one
+# abstract trace, before any mesh compile (BASELINE.md "Mesh pre-flight
+# conventions")
+DEFINE("graph_lint_replication_min_bytes", 1 << 20,
+       "replication-blowup rule: a step-function operand at least this "
+       "big, fully replicated along a checked mesh axis it could shard "
+       "(some dimension divisible by the axis size), is an error — a "
+       "KV cache or weight replicated over mp multiplies its HBM by "
+       "the axis size.  dp is never checked (dp replication of params "
+       "IS the data-parallel contract); rope tables are allowlisted")
+DEFINE("graph_lint_reshard_min_bytes", 1 << 16,
+       "resharding-hazard rule: minimum tensor size for flagging a "
+       "with_sharding_constraint that conflicts with the operand's "
+       "propagated sharding (an implicit cross-device reshard on the "
+       "hot path); smaller tensors reshard for free")
+DEFINE("graph_lint_hbm_tol", 0.02,
+       "mesh pre-flight HBM cross-check tolerance: the liveness "
+       "estimator's predicted per-device KV-cache bytes, scaled back "
+       "by the cache's shard count, must match the engine's "
+       "cache_hbm_bytes within this relative error or the pre-flight "
+       "report carries an hbm-liveness error finding")
 # observability (paddle_tpu/observability): metrics registry + span tracer
 DEFINE("retrace_watchdog", "warn",
        "action when a track_retraces call-site compiles past its trace "

@@ -143,36 +143,34 @@ def accept_draft_tokens(logits, drafts, draft_mask, key, temperature=0.0,
     return jnp.where(keep, out, jnp.int32(pad_token_id)), n
 
 
-def _place_on_mesh(model, params, cache, input_ids, paged_cache=False):
-    """Mesh-native decode (round-3 verdict #3): when a hybrid mesh is
-    active, lay the decode state out on it before jitting —
+def decode_mesh_specs(model, params, axis_names, paged_cache=False):
+    """The DECLARED mesh layout of the decode state, as PartitionSpecs
+    filtered to ``axis_names`` (no devices touched):
 
       * params per their declared TP/FSDP specs (so lm_head stays
         vocab-parallel on ``mp`` and the logits matmul runs sharded, with
-        GSPMD inserting the argmax/sample reduction collectives);
+        GSPMD inserting the argmax/sample reduction collectives) — a
+        spec pytree matching ``params``;
       * the stacked KV cache (L, 2, B, max_len, Hkv, D): batch over
         dp×sharding, kv heads over ``mp`` — the serving layout matching
-        how training shards attention;
+        how training shards attention.  The paged pool
+        (L, 2, num_blocks, block_len, Hkv, D) shards kv heads on ``mp``
+        only: any block can back any slot, so the block axis must NOT
+        be split over the batch axes;
       * input ids: batch over dp×sharding.
 
-    Single-device (no mesh): unchanged pass-through.  Recurrent decode
-    states (Mamba/RWKV pytrees) are left unplaced — GSPMD propagates from
-    the params/ids, and their state layouts are model-specific.
-    """
-    from ..distributed import env as _denv
-
-    mesh = _denv.active_mesh()
-    if mesh is None or all(mesh.shape[a] == 1 for a in mesh.axis_names):
-        return params, cache, input_ids
-    from jax.sharding import NamedSharding
+    :func:`_place_on_mesh` commits these specs with ``device_put``; the
+    static-analysis mesh pre-flight (``ServingEngine.mesh_preflight``)
+    lints against them abstractly, for meshes that need not exist on
+    this host."""
     from jax.sharding import PartitionSpec as P
 
     from ..distributed.fleet.mp_layers import _filter_spec
 
-    names = set(mesh.axis_names)
+    names = set(axis_names)
 
-    def ns(*entries):
-        return NamedSharding(mesh, P(*_filter_spec(entries, names)))
+    def fs(*entries):
+        return P(*_filter_spec(entries, names))
 
     specs = model.param_shardings(include_buffers=True)
 
@@ -192,22 +190,40 @@ def _place_on_mesh(model, params, cache, input_ids, paged_cache=False):
         return None if isinstance(node, dict) else node
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    params = jax.tree_util.tree_unflatten(treedef, [
-        jax.device_put(v, NamedSharding(
-            mesh, P(*_filter_spec(tuple(_lookup(path) or P()), names))))
-        for path, v in flat])
+    param_specs = jax.tree_util.tree_unflatten(treedef, [
+        fs(*tuple(_lookup(path) or P())) for path, _ in flat])
     batch = tuple(a for a in ("dp", "sharding") if a in names)
-    input_ids = jax.device_put(input_ids, ns(batch))
+    if paged_cache:
+        cache_spec = fs(None, None, None, None, "mp", None)
+    else:
+        cache_spec = fs(None, None, batch, None, "mp", None)
+    return param_specs, cache_spec, fs(batch)
+
+
+def _place_on_mesh(model, params, cache, input_ids, paged_cache=False):
+    """Mesh-native decode (round-3 verdict #3): when a hybrid mesh is
+    active, lay the decode state out on it before jitting, per the
+    declared :func:`decode_mesh_specs` layout.
+
+    Single-device (no mesh): unchanged pass-through.  Recurrent decode
+    states (Mamba/RWKV pytrees) are left unplaced — GSPMD propagates from
+    the params/ids, and their state layouts are model-specific.
+    """
+    from ..distributed import env as _denv
+
+    mesh = _denv.active_mesh()
+    if mesh is None or all(mesh.shape[a] == 1 for a in mesh.axis_names):
+        return params, cache, input_ids
+    from jax.sharding import NamedSharding
+
+    param_specs, cache_spec, ids_spec = decode_mesh_specs(
+        model, params, mesh.axis_names, paged_cache=paged_cache)
+    params = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        params, param_specs)
+    input_ids = jax.device_put(input_ids, NamedSharding(mesh, ids_spec))
     if isinstance(cache, jax.Array) and cache.ndim == 6:
-        if paged_cache:
-            # paged pool (L, 2, num_blocks, block_len, Hkv, D): any block
-            # can back any slot, so the block axis must NOT be split over
-            # the batch axes — shard kv heads on mp only
-            cache = jax.device_put(cache, ns(None, None, None, None, "mp",
-                                             None))
-        else:
-            cache = jax.device_put(cache, ns(None, None, batch, None, "mp",
-                                             None))
+        cache = jax.device_put(cache, NamedSharding(mesh, cache_spec))
     return params, cache, input_ids
 
 

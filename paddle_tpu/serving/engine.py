@@ -151,7 +151,8 @@ import numpy as np
 from .. import flags as _flags
 from .. import observability as _obs
 from ..models.generation import (_place_on_mesh, accept_draft_tokens,
-                                 init_kv_cache, sample_tokens)
+                                 decode_mesh_specs, init_kv_cache,
+                                 sample_tokens)
 from ..nn.layer import bind_params
 from ..ops import _dispatch as _disp
 from .drafter import NgramDrafter
@@ -1282,14 +1283,99 @@ class ServingEngine:
         return (self._params, self._cache, *head, *tail_mask, temps,
                 topk, topp, key)
 
-    def lint_step(self):
+    def lint_step(self, mesh=None):
         """Graph-lint this engine's once-jitted step function (one
         abstract trace; the TrackedFunction's stored donate_argnums are
         honoured).  Returns the finding list — the serving contract is
         that it is EMPTY; ``FLAGS_graph_lint`` arms the same check at
-        the first scheduler tick."""
+        the first scheduler tick.
+
+        ``mesh`` (a jax Mesh/AbstractMesh, ``{axis: size}`` dict, or a
+        string like ``"mp2dp2"``) adds the mesh rule set, linting the
+        step under this engine's DECLARED shardings
+        (:func:`~paddle_tpu.models.generation.decode_mesh_specs`) —
+        the same layout ``_place_on_mesh`` commits when a hybrid mesh
+        is active, checked without any devices."""
         from .. import static_analysis as _sa
-        return _sa.analyze(self._step_fn, *self._lint_args())
+        if mesh is None:
+            return _sa.analyze(self._step_fn, *self._lint_args())
+        minfo = _sa.MeshInfo.of(mesh)
+        return _sa.analyze(self._step_fn, *self._lint_args(),
+                           mesh=minfo,
+                           in_shardings=self._mesh_step_shardings(minfo))
+
+    def _mesh_step_shardings(self, minfo):
+        """Per-arg declared shardings for the step signature: params and
+        cache per :func:`decode_mesh_specs`, everything else (token/
+        position/mask vectors, block tables, the PRNG key) replicated —
+        they are tiny and every device needs them whole."""
+        param_specs, cache_spec, _ = decode_mesh_specs(
+            self._bind, self._params, minfo.names,
+            paged_cache=self.paged)
+        args = self._lint_args()
+        specs = [None] * len(args)
+        specs[0], specs[1] = param_specs, cache_spec
+        return tuple(specs)
+
+    def mesh_preflight(self, mesh=None, rules=None) -> Dict[str, object]:
+        """Mesh pre-flight of the once-jitted step (ISSUE 8): findings
+        (graph-lint + mesh rules), the per-axis collective-cost report,
+        and the per-device HBM-liveness estimate, all from ONE abstract
+        trace under this engine's declared shardings — run BEFORE any
+        mesh compile, on a host that need not have the devices.
+
+        The HBM estimate is cross-checked against ``cache_hbm_bytes``:
+        the predicted per-device cache bytes, scaled back by the
+        cache's shard count, must match within
+        ``FLAGS_graph_lint_hbm_tol`` or an ``hbm-liveness`` error
+        finding is appended (``cache_check`` carries the numbers).
+        Predicted comm bytes per axis and predicted peak HBM land in
+        the observability registry as ``mesh.predicted_comm_bytes`` /
+        ``mesh.predicted_peak_hbm_bytes`` gauges, and in the serving
+        bench rows as ``mesh_preflight``."""
+        from .. import static_analysis as _sa
+        if mesh is None:
+            from ..distributed import env as _denv
+            mesh = _denv.active_mesh()
+            if mesh is None:
+                raise ValueError(
+                    "mesh_preflight needs a mesh: pass one (e.g. "
+                    "'mp2dp2') or activate a hybrid group")
+        minfo = _sa.MeshInfo.of(mesh)
+        pf = _sa.preflight(self._step_fn, *self._lint_args(),
+                           mesh=minfo, rules=rules,
+                           in_shardings=self._mesh_step_shardings(minfo))
+        hbm = pf["hbm"]
+        cb = self.cache_hbm_bytes
+        predicted = hbm["cache_bytes_per_device"] * hbm["cache_shards"]
+        tol = float(_flags.flag("graph_lint_hbm_tol"))
+        rel = abs(predicted - cb) / cb if cb else 0.0
+        pf["cache_check"] = {
+            "engine_cache_hbm_bytes": int(cb),
+            "predicted_cache_bytes": int(predicted),
+            "cache_bytes_per_device": int(hbm["cache_bytes_per_device"]),
+            "rel_err": round(rel, 6), "tol": tol, "ok": rel <= tol}
+        if rel > tol:
+            pf["findings"].append(_sa.Finding(
+                "hbm-liveness", "error", "",
+                f"liveness estimate of the cache operand "
+                f"({predicted} bytes over {hbm['cache_shards']} "
+                f"shard(s)) disagrees with cache_hbm_bytes ({cb}) "
+                f"beyond tol {tol} — the step signature and the "
+                f"engine's cache accounting have drifted",
+                bytes=int(abs(predicted - cb))))
+        reg = _obs.default_registry()
+        for axis, row in pf["comm"]["per_axis"].items():
+            reg.gauge(
+                "mesh.predicted_comm_bytes",
+                "pre-flight predicted collective bytes per step, per "
+                "mesh axis").labels(engine=self._eid, axis=axis).set(
+                    row["bytes_per_step"])
+        reg.gauge(
+            "mesh.predicted_peak_hbm_bytes",
+            "pre-flight predicted peak HBM per device for one step"
+            ).labels(engine=self._eid).set(hbm["peak_bytes_per_device"])
+        return pf
 
     @property
     def cache_hbm_bytes(self) -> int:

@@ -23,12 +23,30 @@ device run:
     non-canonical dtypes in the call signature, the before-the-fact
     complement of the retrace watchdog's budget.
 
+The MESH pre-flight layer (ISSUE 8, :mod:`.mesh_rules`) extends the
+same one-trace framework to mesh-partitioned programs: a
+sharding-propagation walker annotates operands with per-axis shardings
+under an ABSTRACT mesh (``"mp2dp2"`` works on a laptop), three more
+rules check the SPMD story — **replication-blowup** (error: a big
+operand fully replicated along an axis it could shard),
+**resharding-hazard** (warning: conflicting
+``with_sharding_constraint``), **collective-deadlock** (error: the
+collective-order lint folded into the rules framework;
+``distributed/lint.py`` is now a shim over the shared walker) — and
+two cost models report predicted per-axis collective bytes per step
+(:func:`comm_report`) and donation-aware per-device peak HBM
+(:func:`estimate_peak_hbm`), cross-checked against
+``ServingEngine.cache_hbm_bytes`` by ``mesh_preflight``.
+
 API mirrors the collective lint: :func:`analyze` returns findings,
-:func:`check` raises :class:`GraphLintError` on any.  ``FLAGS_graph_lint``
-(off/warn/raise) arms the serving engines' self-lint — every
-``ServingEngine`` lints its own once-jitted step at the first tick —
-and ``python -m paddle_tpu.static_analysis`` lints a tiny-config engine
-step in every cache layout and prints the report.
+:func:`check` raises :class:`GraphLintError` on any; both take
+``mesh=`` / ``in_shardings=`` for the pre-flight path, and
+:func:`preflight` returns findings + comm + HBM from one trace.
+``FLAGS_graph_lint`` (off/warn/raise) arms the serving engines'
+self-lint — every ``ServingEngine`` lints its own once-jitted step at
+the first tick — and ``python -m paddle_tpu.static_analysis`` lints a
+tiny-config engine step in every cache layout and prints the report
+(``--mesh mp2dp2`` for the SPMD pre-flight).
 
 A lint pass is ONE ``jax.make_jaxpr`` trace: abstract, no compile, no
 device dispatch.
@@ -40,33 +58,44 @@ import warnings
 from typing import List, Optional, Sequence
 
 from .. import flags as _flags
-from . import core, rules
+from . import core, mesh_rules as _mesh_rules, rules
 from .core import (Finding, GraphLintError, GraphLintWarning,
-                   LintContext, trace_for_lint)
+                   LintContext, MeshInfo, MeshLintContext, trace_for_lint,
+                   trace_for_mesh_lint)
+from .mesh_rules import (CollectiveDeadlockRule, ReplicationBlowupRule,
+                         ReshardingHazardRule, comm_report,
+                         default_mesh_rules, estimate_peak_hbm)
 from .rules import (ConstantCaptureRule, DonationRule, DtypePromotionRule,
                     HostSyncRule, RetraceHazardRule, Rule, default_rules)
 
 __all__ = [
     "Finding", "GraphLintError", "GraphLintWarning", "LintContext",
+    "MeshInfo", "MeshLintContext",
     "Rule", "DonationRule", "DtypePromotionRule", "ConstantCaptureRule",
     "HostSyncRule", "RetraceHazardRule", "default_rules",
+    "ReplicationBlowupRule", "ReshardingHazardRule",
+    "CollectiveDeadlockRule", "default_mesh_rules", "comm_report",
+    "estimate_peak_hbm", "preflight",
     "analyze", "check", "enforce", "report", "trace_for_lint",
+    "trace_for_mesh_lint",
 ]
 
+# findings sort: errors first, then a total deterministic order so two
+# runs of the same program produce byte-identical reports (the --json
+# CLI contract CI diffs ride on)
+_SEVERITY_ORDER = {"error": 0, "warning": 1}
 
-def analyze(fn, *args, donate_argnums=None, donate_argnames=None,
-            rules: Optional[Sequence[Rule]] = None,
-            **kwargs) -> List[Finding]:
-    """Trace ``fn`` abstractly and run the graph-lint rules; returns
-    findings (errors first) without raising.
 
-    ``fn`` must be a PYTHON function (pre-jit).  A ``track_retraces``
-    wrapper (observability/watchdog.py) is unwrapped automatically: its
-    stored ``python_fn`` is traced — never the counted body, so a lint
-    pass costs no watchdog budget — and its ``jit_kwargs`` supply
-    ``donate_argnums``/``donate_argnames`` unless given explicitly, so
-    ``analyze(engine._step_fn, *args)`` sees exactly what the real call
-    site donates."""
+def _sort_findings(findings: List[Finding]) -> List[Finding]:
+    findings.sort(key=lambda f: (
+        _SEVERITY_ORDER.get(f.severity, 2), f.rule, f.path,
+        -1 if f.bytes is None else -int(f.bytes), f.message))
+    return findings
+
+
+def _unwrap(fn, donate_argnums, donate_argnames):
+    """Resolve a ``track_retraces`` wrapper to its pre-jit python body
+    and the donation marks of the real jit call site."""
     raw = getattr(fn, "python_fn", None)
     if raw is not None:                          # TrackedFunction
         jk = dict(getattr(fn, "jit_kwargs", None) or {})
@@ -75,15 +104,53 @@ def analyze(fn, *args, donate_argnums=None, donate_argnames=None,
         if donate_argnames is None:
             donate_argnames = jk.get("donate_argnames", ())
         fn = raw
-    ctx = trace_for_lint(fn, *args,
-                         donate_argnums=donate_argnums or (),
-                         donate_argnames=donate_argnames or (), **kwargs)
+    return fn, (donate_argnums or ()), (donate_argnames or ())
+
+
+def _trace(fn, args, kwargs, donate_argnums, donate_argnames,
+           mesh, in_shardings):
+    fn, dnums, dnames = _unwrap(fn, donate_argnums, donate_argnames)
+    if mesh is None:
+        return trace_for_lint(fn, *args, donate_argnums=dnums,
+                              donate_argnames=dnames, **kwargs)
+    return trace_for_mesh_lint(fn, *args, mesh=mesh,
+                               in_shardings=in_shardings,
+                               donate_argnums=dnums,
+                               donate_argnames=dnames, **kwargs)
+
+
+def analyze(fn, *args, donate_argnums=None, donate_argnames=None,
+            rules: Optional[Sequence[Rule]] = None,
+            mesh=None, in_shardings=None,
+            **kwargs) -> List[Finding]:
+    """Trace ``fn`` abstractly and run the graph-lint rules; returns
+    findings (errors first, deterministically ordered) without raising.
+
+    ``fn`` must be a PYTHON function (pre-jit).  A ``track_retraces``
+    wrapper (observability/watchdog.py) is unwrapped automatically: its
+    stored ``python_fn`` is traced — never the counted body, so a lint
+    pass costs no watchdog budget — and its ``jit_kwargs`` supply
+    ``donate_argnums``/``donate_argnames`` unless given explicitly, so
+    ``analyze(engine._step_fn, *args)`` sees exactly what the real call
+    site donates.
+
+    ``mesh=`` selects the MESH pre-flight path (ISSUE 8): the trace is
+    annotated with per-axis shardings (``in_shardings`` — per-arg specs
+    — or the args' committed NamedShardings; undeclared = replicated),
+    propagated through the jaxpr, and the mesh rule set
+    (replication-blowup / resharding-hazard / collective-deadlock)
+    runs alongside the base rules.  ``mesh`` may be a jax
+    ``Mesh``/``AbstractMesh``, a ``{axis: size}`` dict, or a string
+    like ``"mp2dp2"`` — no devices are needed."""
+    ctx = _trace(fn, args, kwargs, donate_argnums, donate_argnames,
+                 mesh, in_shardings)
+    if rules is None:
+        rules = default_rules() + (default_mesh_rules()
+                                   if mesh is not None else ())
     findings: List[Finding] = []
-    for rule in (rules if rules is not None else default_rules()):
+    for rule in rules:
         findings.extend(rule.run(ctx))
-    order = {"error": 0, "warning": 1}
-    findings.sort(key=lambda f: order.get(f.severity, 2))
-    return findings
+    return _sort_findings(findings)
 
 
 def report(findings: Sequence[Finding], context: str = "") -> str:
@@ -101,6 +168,31 @@ def check(fn, *args, **kwargs) -> List[Finding]:
     if findings:
         raise GraphLintError(report(findings))
     return findings
+
+
+def preflight(fn, *args, mesh, in_shardings=None,
+              donate_argnums=None, donate_argnames=None,
+              rules: Optional[Sequence[Rule]] = None,
+              **kwargs) -> dict:
+    """Full mesh pre-flight of one traced program: findings (base +
+    mesh rules), the per-axis collective-cost report, and the
+    per-device HBM-liveness estimate — all from ONE abstract trace.
+    This is the report ``ServingEngine.mesh_preflight`` wraps and the
+    ``--mesh`` CLI prints; see BASELINE.md "Mesh pre-flight
+    conventions" for the accounting definitions."""
+    ctx = _trace(fn, args, kwargs, donate_argnums, donate_argnames,
+                 mesh, in_shardings)
+    if rules is None:
+        rules = default_rules() + default_mesh_rules()
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.run(ctx))
+    _sort_findings(findings)
+    return {"mesh": ctx.mesh.as_dict(),
+            "fn": ctx.fn_name,
+            "findings": findings,
+            "comm": comm_report(ctx),
+            "hbm": estimate_peak_hbm(ctx)}
 
 
 def enforce(findings: Sequence[Finding],

@@ -5,11 +5,20 @@ Builds a tiny-config llama ServingEngine in every cache layout
 speculative-decode verify step in both cache layouts and its chunked
 composition), runs the graph-lint suite over each once-jitted step
 function via ``engine.lint_step()`` (one abstract trace per layout — no
-compile, no device step), and prints the findings.  Exit status 0 =
-clean, 1 = findings.
+compile, no device step), and prints the findings.
+
+``--mesh mp2dp2`` runs the MESH pre-flight (ISSUE 8) instead: every
+layout is linted under its declared shardings with the mesh rule set
+armed (replication-blowup / resharding-hazard / collective-deadlock),
+the per-axis collective-cost and per-device HBM-liveness numbers are
+printed, the HBM prediction is cross-checked against the engine's
+``cache_hbm_bytes``, and the in-tree mesh-native decode step (the
+``generate()`` scan body under ``decode_mesh_specs``) is linted as one
+more layout.  The mesh is ABSTRACT — the axes need not exist on this
+host, so a laptop can pre-flight a pod topology.
 
 This is the CI smoke for the "zero findings on the serving hot path"
-contract (ISSUE 6 acceptance): the same lint the engines self-run at
+contract (ISSUE 6/8 acceptance): the same lint the engines self-run at
 their first tick under ``FLAGS_graph_lint``, invocable standalone.
 """
 
@@ -20,12 +29,27 @@ import json
 import sys
 from typing import List, Optional
 
+# --json output contract: bump when the blob SHAPE changes.  v1 was the
+# unversioned ISSUE-6 {layout: [findings]} mapping; v2 nests per-layout
+# reports under "layouts" and adds the mesh pre-flight blocks.
+SCHEMA_VERSION = 2
+
+_EPILOG = """\
+exit status: 0 = every layout linted clean (and, with --mesh, every
+HBM cross-check passed); 1 = at least one finding; 2 = bad usage
+(argparse).  --json prints one deterministic JSON object (findings
+sorted by severity/rule/path/bytes/message, schema_version=%d) for CI
+artifact diffs.""" % SCHEMA_VERSION
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.static_analysis",
         description="Graph-lint a tiny-config ServingEngine step in "
-                    "every cache layout")
+                    "every cache layout; --mesh adds the SPMD "
+                    "pre-flight (sharding, collective-cost, "
+                    "HBM-liveness) under an abstract mesh",
+        epilog=_EPILOG)
     ap.add_argument("--slots", type=int, default=2,
                     help="engine slots (default 2)")
     ap.add_argument("--max-length", type=int, default=64,
@@ -36,19 +60,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="chunked-prefill chunk (default 8)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="speculative draft window (default 4)")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="mesh pre-flight under an abstract mesh given "
+                         "as <axis><size> pairs, e.g. mp2dp2 (axis "
+                         "names: mp/dp/sharding/sep/pp); no devices "
+                         "needed")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings instead of the report")
+                    help="machine-readable report instead of text "
+                         "(schema_version %d; see epilog)"
+                         % SCHEMA_VERSION)
     args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
 
     import paddle_tpu as pt
     from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    from paddle_tpu.models.generation import decode_mesh_specs
+    from paddle_tpu.nn.layer import bind_params
     from paddle_tpu.serving import ServingEngine
 
-    from . import report
+    from . import MeshInfo, analyze, preflight, report
 
     pt.seed(0)
     model = LlamaForCausalLM(tiny_llama_config())
     model.eval()
+    minfo = MeshInfo.of(args.mesh) if args.mesh else None
 
     variants = [
         ("contiguous", {}),
@@ -72,32 +108,110 @@ def main(argv: Optional[List[str]] = None) -> int:
               spec_k=args.spec_k)),
     ]
     total = 0
-    blob = {}
+    layouts = {}
     for name, kw in variants:
         eng = ServingEngine(model, num_slots=args.slots,
                             max_length=args.max_length, **kw)
-        findings = eng.lint_step()
-        total += len(findings)
-        if args.json:
-            blob[name] = [f.as_dict() for f in findings]
+        entry = {"cache_hbm_bytes": int(eng.cache_hbm_bytes)}
+        if minfo is None:
+            findings = eng.lint_step()
         else:
-            cache_mb = eng.cache_hbm_bytes / 1e6
-            status = "clean" if not findings else "FINDINGS"
-            print(f"[graph-lint] serving.step[{name}] "
-                  f"(cache {cache_mb:.2f} MB): {status}")
-            if findings:
-                print(report(findings, context=f"serving.step[{name}]"))
+            pf = eng.mesh_preflight(minfo)
+            findings = pf["findings"]
+            entry["comm_bytes_per_step"] = {
+                a: row["bytes_per_step"]
+                for a, row in pf["comm"]["per_axis"].items()}
+            entry["peak_hbm_bytes_per_device"] = (
+                pf["hbm"]["peak_bytes_per_device"])
+            entry["cache_check"] = pf["cache_check"]
+        entry["findings"] = [f.as_dict() for f in findings]
+        layouts[name] = entry
+        total += len(findings)
+        if not args.json:
+            _print_layout(f"serving.step[{name}]", entry, findings,
+                          report)
+
+    if minfo is not None:
+        entry, findings = _mesh_decode_step_entry(
+            model, minfo, args.slots, args.max_length, jnp,
+            bind_params, decode_mesh_specs, analyze, preflight)
+        layouts["mesh_decode_step"] = entry
+        total += len(findings)
+        if not args.json:
+            _print_layout("generate.decode_step[mesh]", entry, findings,
+                          report)
+
     if args.json:
-        print(json.dumps(blob, indent=1))
+        blob = {"schema_version": SCHEMA_VERSION,
+                "mesh": minfo.as_dict() if minfo else None,
+                "total_findings": total,
+                "layouts": layouts}
+        print(json.dumps(blob, indent=1, sort_keys=True))
     elif not total:
-        print(f"[graph-lint] 0 findings across {len(variants)} layouts "
-              f"({len(default_rule_names())} rules armed)")
+        nrules = len(default_rule_names(mesh=minfo is not None))
+        where = f" under mesh {minfo.as_dict()}" if minfo else ""
+        print(f"[graph-lint] 0 findings across {len(layouts)} layouts"
+              f"{where} ({nrules} rules armed)")
     return 1 if total else 0
 
 
-def default_rule_names() -> List[str]:
-    from . import default_rules
-    return [r.name for r in default_rules()]
+def _print_layout(label, entry, findings, report):
+    cache_mb = entry["cache_hbm_bytes"] / 1e6
+    status = "clean" if not findings else "FINDINGS"
+    extra = ""
+    if "peak_hbm_bytes_per_device" in entry:
+        comm = sum(entry["comm_bytes_per_step"].values())
+        extra = (f", comm {comm} B/step, "
+                 f"peak {entry['peak_hbm_bytes_per_device'] / 1e6:.2f} "
+                 f"MB/device")
+    print(f"[graph-lint] {label} (cache {cache_mb:.2f} MB{extra}): "
+          f"{status}")
+    if findings:
+        print(report(findings, context=label))
+
+
+def _mesh_decode_step_entry(model, minfo, slots, max_length, jnp,
+                            bind_params, decode_mesh_specs, analyze,
+                            preflight):
+    """Lint the in-tree mesh-native decode step — the ``generate()``
+    scan body (decode-at-depth, one token per row) under the declared
+    ``decode_mesh_specs`` layout — as one more pre-flight target."""
+    from ..models.generation import init_kv_cache
+
+    bind = getattr(model, "unwrapped", model)
+    prepare = getattr(model, "_prepare_params", lambda p: p)
+    params = model.state_dict(include_buffers=True)
+    cache = init_kv_cache(model.config, slots, max_length)
+
+    def decode_step(params, cache, tokens, positions):
+        with bind_params(bind, prepare(params)):
+            logits, cache = model.decode_step(
+                tokens[:, None], cache, positions)
+        return jnp.argmax(logits[:, -1], axis=-1), cache
+
+    param_specs, cache_spec, ids_spec = decode_mesh_specs(
+        model, params, minfo.names)
+    toks = jnp.zeros((slots,), jnp.int32)
+    pos = jnp.zeros((slots,), jnp.int32)
+    pf = preflight(decode_step, params, cache, toks, pos,
+                   mesh=minfo, donate_argnums=(1,),
+                   in_shardings=(param_specs, cache_spec, ids_spec,
+                                 ids_spec))
+    findings = pf["findings"]
+    entry = {
+        "cache_hbm_bytes": int(cache.nbytes),
+        "comm_bytes_per_step": {
+            a: row["bytes_per_step"]
+            for a, row in pf["comm"]["per_axis"].items()},
+        "peak_hbm_bytes_per_device": pf["hbm"]["peak_bytes_per_device"],
+        "findings": [f.as_dict() for f in findings]}
+    return entry, findings
+
+
+def default_rule_names(mesh: bool = False) -> List[str]:
+    from . import default_mesh_rules, default_rules
+    rules = default_rules() + (default_mesh_rules() if mesh else ())
+    return [r.name for r in rules]
 
 
 if __name__ == "__main__":
