@@ -1154,6 +1154,173 @@ def _spec_decode_bench(model, on_tpu):
                          "no TPU device in this environment"}}
 
 
+def _mesh_serving_bench(model, on_tpu):
+    """Mesh-sharded serving A/B (ISSUE 9), two halves:
+
+      * **mp engine** — the SAME trace through a single-chip engine and
+        a ``mesh="mp2dp2"``-placed engine (params/cache per
+        decode_mesh_specs, declared in/out shardings, cache donated):
+        greedy outputs must be token-identical, the step compiles once,
+        and the pre-flight PREDICTIONS are asserted against the
+        program's ACTUALS — placed per-device cache bytes vs the
+        HBM-liveness estimate (``mesh_placement_check``,
+        FLAGS_graph_lint_hbm_tol), and the predicted mp collectives vs
+        the collective ops in the compiled HLO (presence must agree;
+        GSPMD may fuse, so the count is recorded, not asserted —
+        BASELINE.md predicted-vs-measured conventions);
+      * **dp router** — a shared-system-prompt trace (two tenant
+        families, random arrival order) through a 2-replica
+        ``ReplicaRouter`` under the prefix-affinity policy vs
+        round-robin: the pooled prefix hit rate must be strictly higher
+        under prefix routing (the whole point of hashing warm tries),
+        outputs identical under both.
+
+    On CPU this is a plumbing smoke over the 8 virtual devices (tok/s
+    numbers have no perf meaning); the multi-chip tok/s scaling claim
+    is the pending TPU-pod re-run."""
+    import re
+
+    import numpy as np
+
+    import jax
+    from paddle_tpu.serving import ReplicaRouter, ServingEngine
+
+    ndev = len(jax.devices())
+    if ndev < 4:
+        return {"status": "pending_tpu_pod",
+                "note": f"mp2dp2 needs 4 devices; this host has {ndev} "
+                        f"— run on a pod slice (CPU smoke fakes 8 "
+                        f"devices via xla_force_host_platform_device_"
+                        f"count)"}
+    if on_tpu:
+        slots, max_len, n_req, bl = 8, 2048, 32, 128
+        sys_len, plo, phi, nlo, nhi = 256, 32, 128, 32, 96
+    else:  # plumbing smoke: tiny trace, no perf meaning
+        slots, max_len, n_req, bl = 2, 128, 10, 16
+        sys_len, plo, phi, nlo, nhi = 32, 4, 16, 4, 10
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    prompts = [rng.randint(0, vocab, rng.randint(plo, phi + 1))
+               .astype(np.int32) for _ in range(n_req)]
+    news = rng.randint(nlo, nhi + 1, n_req)
+
+    def run(eng):
+        rids = [eng.submit(p, max_new_tokens=int(news[i]))
+                for i, p in enumerate(prompts)]
+        while eng.num_active or eng.queue_depth or eng.num_pending:
+            eng.step()
+        return [eng.result(r) for r in rids]
+
+    single = ServingEngine(model, num_slots=slots, max_length=max_len)
+    meshed = ServingEngine(model, num_slots=slots, max_length=max_len,
+                           mesh="mp2dp2")
+    run(single), run(meshed)                       # compile + warm
+    t0 = time.perf_counter()
+    out_single = run(single)
+    t_single = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out_mesh = run(meshed)
+    t_mesh = time.perf_counter() - t0
+    toks = sum(len(o) for o in out_mesh)
+
+    pf = meshed.mesh_preflight()
+    # compiled actuals: re-jit the RAW step body (python_fn — no trace
+    # counted against the budget) with the engine's own jit kwargs and
+    # count the collective ops GSPMD actually emitted
+    jf = jax.jit(meshed._step_fn.python_fn, **meshed._step_fn.jit_kwargs)
+    hlo = jf.lower(*meshed._lint_args()).compile().as_text()
+    compiled = {k: len(re.findall(rf"\b{k}(?:-start)?\(", hlo))
+                for k in ("all-reduce", "all-gather", "all-to-all",
+                          "collective-permute")}
+    pred_mp = pf["comm"]["per_axis"]["mp"]
+    pred_count = int(sum(pred_mp["collectives"].values()))
+    mp_block = {
+        "mesh": "mp2dp2",
+        "greedy_parity": out_single == out_mesh,
+        "generated_tokens": int(toks),
+        "tokens_per_sec_single_chip": round(toks / t_single, 1),
+        "tokens_per_sec_mesh": round(toks / t_mesh, 1),
+        "step_traces": meshed.step_traces,
+        "preflight_findings": len(pf["findings"]),
+        "placement_check": pf["placement_check"],
+        "comm_predicted_bytes_per_axis": {
+            a: row["bytes_per_step"]
+            for a, row in pf["comm"]["per_axis"].items()},
+        "comm_predicted_mp_collectives": {
+            k: int(v) for k, v in sorted(pred_mp["collectives"].items())},
+        "compiled_collective_ops": compiled,
+        "comm_check_ok": (compiled["all-reduce"] > 0) == (pred_count > 0)}
+
+    # dp router A/B: two tenant families sharing system prompts,
+    # arrival order randomised — round-robin splits each family across
+    # both replicas (every other request recomputes the prefix cold),
+    # prefix-affinity routing lands each family on its warm trie
+    r2 = np.random.RandomState(1)
+    fams = [r2.randint(0, vocab, sys_len).astype(np.int32)
+            for _ in range(2)]
+    rtrace = [np.concatenate([fams[int(r2.rand() < 0.5)],
+                              r2.randint(0, vocab, r2.randint(2, phi))
+                              .astype(np.int32)]) for _ in range(n_req)]
+    rnews = r2.randint(nlo, nhi + 1, n_req)
+
+    def run_router(policy):
+        router = ReplicaRouter(model, num_replicas=2, policy=policy,
+                               paged=True, block_len=bl,
+                               num_slots=slots, max_length=max_len)
+        t0 = time.perf_counter()
+        rids = []
+        for i, p in enumerate(rtrace):
+            rids.append(router.submit(p, max_new_tokens=int(rnews[i])))
+            router.step()
+            router.step()          # stagger: the trie warms mid-trace
+        outs = dict(router.drain())
+        wall = time.perf_counter() - t0
+        agg = router.metrics()["aggregate"]
+        return [outs[r] for r in rids], agg, wall
+
+    out_px, agg_px, wall_px = run_router("prefix")
+    out_rr, agg_rr, wall_rr = run_router("round_robin")
+    router_block = {
+        "replicas": 2, "trace_requests": n_req,
+        "shared_prompt_len": sys_len,
+        "trace": "two tenant families share system prompts, random "
+                 "arrival order, submissions interleaved with ticks",
+        "greedy_parity_across_policies": out_px == out_rr,
+        "prefix_policy": {
+            "prefix_hit_rate_pooled": agg_px["prefix_hit_rate_pooled"],
+            "prefix_hit_rate_per_replica":
+                agg_px["prefix_hit_rate_per_replica"],
+            "aggregate_tokens": agg_px["tokens_generated"],
+            "aggregate_tokens_per_sec": round(
+                agg_px["tokens_generated"] / wall_px, 1),
+            "prefix_routed_tokens": agg_px["prefix_routed_tokens"]},
+        "round_robin": {
+            "prefix_hit_rate_pooled": agg_rr["prefix_hit_rate_pooled"],
+            "prefix_hit_rate_per_replica":
+                agg_rr["prefix_hit_rate_per_replica"],
+            "aggregate_tokens": agg_rr["tokens_generated"],
+            "aggregate_tokens_per_sec": round(
+                agg_rr["tokens_generated"] / wall_rr, 1)},
+        "prefix_beats_round_robin": (
+            agg_px["prefix_hit_rate_pooled"]
+            > agg_rr["prefix_hit_rate_pooled"])}
+
+    return {"mp_engine": mp_block, "dp_router": router_block,
+            "note": "CPU rows are plumbing smokes (8 virtual devices; "
+                    "wall includes each router's first-pass compiles); "
+                    "aggregate tok/s sums per-replica committed tokens, "
+                    "pooled hit rate re-divides summed hits by summed "
+                    "prompt tokens — BASELINE.md multi-replica "
+                    "accounting",
+            "tpu_recheck": {
+                "status": "pending_tpu",
+                "command": "bench.py --sections mesh_serving",
+                "claim": "aggregate tok/s scales with dp replicas and "
+                         "mp fits models past one chip's HBM at the "
+                         "weight-stream bound; no multi-chip TPU in "
+                         "this environment"}}
+
+
 def _merge_decode_artifact(section_key, section):
     """Incremental write: each finished section lands on disk immediately,
     so a wedged later section (tunnel RPC hangs are real — round 5) never
@@ -1174,6 +1341,14 @@ def run_decode_bench(args):
     """bench.py --decode → BENCH_DECODE.json + one JSON line."""
     import faulthandler
     faulthandler.dump_traceback_later(1200, exit=False)  # hang diagnostics
+    if "mesh_serving" in (args.sections or ""):
+        # the mp2dp2 engine A/B needs >= 4 devices; on the CPU smoke
+        # host fake them the way tests/conftest.py does (must precede
+        # the first jax backend initialisation below)
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8")
     import jax
 
     dev = jax.devices()[0]
@@ -1208,7 +1383,7 @@ def run_decode_bench(args):
     model = params = None
     n = pbytes = 0
     if want & {"prefill", "decode", "int8", "e2e", "serving",
-               "spec_decode"}:
+               "spec_decode", "mesh_serving"}:
         model, params, n = _decode_model(max_pos=8192 if on_tpu else 512,
                                          on_tpu=on_tpu)
         pbytes = n * 2                                  # bf16 weights
@@ -1389,6 +1564,23 @@ def run_decode_bench(args):
               f"{rh['draft_hit_rate']}, parity {rh['greedy_parity']} / "
               f"{sp['adversarial']['greedy_parity']}", file=sys.stderr)
 
+    # -- mesh-sharded serving: mp engine + dp router A/B -----------------
+    if "mesh_serving" in want:
+        print("[decode-bench] mesh serving A/B ...", file=sys.stderr)
+        ms = _mesh_serving_bench(model, on_tpu)
+        _merge_decode_artifact(skey, {"mesh_serving": ms})
+        if "mp_engine" in ms:
+            print(f"mesh_serving: parity "
+                  f"{ms['mp_engine']['greedy_parity']}, preflight "
+                  f"findings {ms['mp_engine']['preflight_findings']}, "
+                  f"router pooled hit rate "
+                  f"{ms['dp_router']['prefix_policy']['prefix_hit_rate_pooled']}"
+                  f" (prefix) vs "
+                  f"{ms['dp_router']['round_robin']['prefix_hit_rate_pooled']}"
+                  f" (round-robin)", file=sys.stderr)
+        else:
+            print(f"mesh_serving: {ms['status']}", file=sys.stderr)
+
     # -- fused_multi_transformer vs per-layer stack ----------------------
     if "fused" in want:
         print("[decode-bench] fused_multi_transformer vs stack ...",
@@ -1522,7 +1714,9 @@ def main():
                     help="comma list for the decode/serving harness: "
                          "prefill,decode,int8,e2e,fused (default all) "
                          "plus the opt-in continuous-batching 'serving' "
-                         "trace and the 'spec_decode' speculative A/B; "
+                         "trace, the 'spec_decode' speculative A/B and "
+                         "the 'mesh_serving' mp-engine + dp-router A/B "
+                         "(needs 4+ devices; the CPU smoke fakes 8); "
                          "implies --decode")
     ap.add_argument("--no-lane", action="store_true", dest="no_lane",
                     help="skip the embedded tpu_lane correctness summary "

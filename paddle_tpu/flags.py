@@ -209,6 +209,30 @@ DEFINE("serving_spec_ngram", 3,
        "longest n-gram the prompt-lookup self-drafter matches against "
        "each slot's prompt+generated history when proposing drafts "
        "(it backs off to shorter n-grams, floor 1, before giving up)")
+# mesh-sharded serving (serving/engine.py mesh=... + serving/router.py):
+# the tensor-parallel engine step and the data-parallel replica router —
+# ROADMAP item 1's multi-chip execution path
+DEFINE("serving_mesh", "",
+       "ServingEngine default mesh: a compact axis string like 'mp2dp2' "
+       "resolved over the first matching prefix of jax.devices() at "
+       "engine construction (empty = single-chip; the engine "
+       "constructor's mesh argument overrides).  Params/cache are "
+       "placed per models.generation.decode_mesh_specs and the "
+       "once-jitted step runs under declared in_shardings with the "
+       "cache operand still donated")
+DEFINE("serving_dp_replicas", 1,
+       "ReplicaRouter default replica count: data-parallel ServingEngine "
+       "replicas behind one submit() (serving/router.py); each replica "
+       "owns its KV cache/block pool while the model params are shared "
+       "host-side.  1 = a trivial single-replica router")
+DEFINE("serving_router_policy", "prefix",
+       "ReplicaRouter placement policy: 'prefix' hashes the longest "
+       "trie-matched prompt prefix to the replica holding the warm "
+       "blocks (falling back to least-loaded when no replica has a "
+       "full-block match), 'least_loaded' ranks replicas by queue depth "
+       "+ pending chunks + busy slots, 'round_robin' rotates.  Session "
+       "affinity overrides every policy: a session's requests never "
+       "migrate off their replica")
 # graph lint (paddle_tpu/static_analysis): jaxpr static analysis of the
 # serving hot path — donation, dtype widening, constant capture,
 # host-sync, retrace hazards — one abstract trace, before any device run

@@ -200,10 +200,15 @@ def decode_mesh_specs(model, params, axis_names, paged_cache=False):
     return param_specs, cache_spec, fs(batch)
 
 
-def _place_on_mesh(model, params, cache, input_ids, paged_cache=False):
+def _place_on_mesh(model, params, cache, input_ids, paged_cache=False,
+                   mesh=None):
     """Mesh-native decode (round-3 verdict #3): when a hybrid mesh is
     active, lay the decode state out on it before jitting, per the
     declared :func:`decode_mesh_specs` layout.
+
+    ``mesh``: an explicit jax Mesh overriding the global active mesh —
+    the mesh-sharded ServingEngine passes its own, so an engine can be
+    mesh-placed without installing a process-global hybrid group.
 
     Single-device (no mesh): unchanged pass-through.  Recurrent decode
     states (Mamba/RWKV pytrees) are left unplaced — GSPMD propagates from
@@ -211,7 +216,8 @@ def _place_on_mesh(model, params, cache, input_ids, paged_cache=False):
     """
     from ..distributed import env as _denv
 
-    mesh = _denv.active_mesh()
+    if mesh is None:
+        mesh = _denv.active_mesh()
     if mesh is None or all(mesh.shape[a] == 1 for a in mesh.axis_names):
         return params, cache, input_ids
     from jax.sharding import NamedSharding

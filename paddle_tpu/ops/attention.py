@@ -150,12 +150,44 @@ def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
     return path, reason
 
 
+def _mesh_sharded_trace() -> bool:
+    """True when the current trace runs BARE under a multi-device mesh
+    (the serving engine's mesh step, or a globally installed hybrid
+    group with any axis > 1).  A bare ``pallas_call`` is opaque to
+    GSPMD — the partitioner would replicate its operands onto every
+    device, undoing the sharding — so mesh-partitioned programs take
+    the XLA math/gather path, which GSPMD partitions natively
+    (vocab-parallel logits, mp-sharded cache contractions).  Inside a
+    ``shard_map``/pmap body the trace is PER-SHARD (a named axis env is
+    bound) and the kernel is exactly right — ring/context-parallel
+    attention already runs Pallas that way — so those traces are
+    exempt.  Wiring the decode kernel itself through ``shard_map`` is
+    the future mesh fast path this dispatch rule gates."""
+    from ..distributed import env as _denv
+    mesh = _denv.active_mesh()
+    if mesh is None:
+        return False
+    if not any(mesh.shape[a] > 1 for a in mesh.axis_names):
+        return False
+    try:                       # per-shard (shard_map/pmap) trace: exempt
+        from jax._src.core import nonempty_axis_env
+        if nonempty_axis_env():
+            return False
+    except ImportError:        # future jax: fail toward the safe gate
+        pass
+    return True
+
+
 def _decode_attention_decision(b, s, hq, hkv, d, kv_len, has_extra_mask,
                                paged_block_len):
     from .. import flags as _flags
     if not _dispatch.use_pallas():
         return "xla_math", (f"no Pallas-capable backend "
                             f"({_dispatch.default_backend()})")
+    if _mesh_sharded_trace():
+        return "xla_math", ("mesh-sharded trace: Pallas-under-shard_map "
+                            "is not wired; the XLA gather path "
+                            "partitions under GSPMD")
     if has_extra_mask:
         return "xla_math", "extra_mask"
     if kv_len < int(_flags.flag("decode_attention_min_len")):
@@ -237,7 +269,8 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
         except NotImplementedError as e:
             reason = str(e)
     if _dispatch.use_pallas() and not reason.startswith(
-            ("no Pallas", "kv_len", "extra_mask", "paged block_len")):
+            ("no Pallas", "kv_len", "extra_mask", "paged block_len",
+             "mesh-sharded")):
         # an above-threshold shape falling back IS a perf surprise worth
         # one log line; below-threshold / masked shapes are the design
         vlog_once(1, f"decode_attention:{reason}",
@@ -392,7 +425,13 @@ def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                   f"({_dispatch.default_backend()})", warn=False)
     else:
         reason = None
-        if dropout_p != 0.0:
+        if _mesh_sharded_trace():
+            # same gate as the decode dispatch: a bare pallas_call would
+            # force GSPMD to replicate its operands; the XLA reference
+            # partitions cleanly, so the fallback IS the design here
+            # (warn=False below skips the one-shot log for it)
+            reason = "mesh-sharded trace (GSPMD partitions the XLA path)"
+        elif dropout_p != 0.0:
             reason = "dropout_p != 0"
         elif attn_mask is not None:
             reason = "custom attn_mask"
@@ -410,7 +449,7 @@ def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                 return (out, lse) if return_lse else out
             except NotImplementedError as e:
                 reason = str(e)
-        _fallback(reason)
+        _fallback(reason, warn=not reason.startswith("mesh-sharded"))
     _dispatch.count_kernel_path("flash_attention", "xla_reference")
     if segment_ids is not None:
         seg = segment_mask(segment_ids,

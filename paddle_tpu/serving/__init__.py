@@ -14,6 +14,7 @@ admits queued requests into freed slots mid-flight.
 from .drafter import NgramDrafter
 from .engine import Request, SamplingParams, ServingEngine
 from .kv_cache import BlockManager, init_paged_kv_cache
+from .router import ReplicaRouter
 
 __all__ = ["ServingEngine", "SamplingParams", "Request", "BlockManager",
-           "init_paged_kv_cache", "NgramDrafter"]
+           "init_paged_kv_cache", "NgramDrafter", "ReplicaRouter"]

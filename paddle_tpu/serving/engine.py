@@ -139,6 +139,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import functools
 import itertools
 import time
 from collections import deque
@@ -232,7 +233,8 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  chunk_policy: Optional[str] = None,
                  spec_decode: Optional[bool] = None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 mesh=None):
         """``paged`` (default FLAGS_serving_paged_kv) selects the paged
         block-pool cache; ``block_len`` (FLAGS_kv_cache_block_len) and
         ``num_blocks`` (FLAGS_kv_cache_num_blocks; 0 derives the
@@ -257,7 +259,23 @@ class ServingEngine:
         greedy outputs token-identical to plain decode, 1..k+1 tokens
         per step.  Composes with every cache layout and with chunked
         prefill (the verify window replaces the mixed step's decode
-        half)."""
+        half).
+
+        ``mesh`` (default FLAGS_serving_mesh) makes the engine
+        MESH-NATIVE — the tensor-parallel execution path of ROADMAP
+        item 1: a jax ``Mesh``, a ``HybridCommunicateGroup``, or a
+        compact axis string like ``"mp2dp2"`` (resolved over the first
+        matching prefix of ``jax.devices()``).  Params and the KV cache
+        are placed per :func:`decode_mesh_specs` at construction
+        (vocab-parallel lm_head on ``mp``, cache kv-heads mp-sharded —
+        the paged block pool shards ONLY the head dim, so block tables
+        stay per-replica logical and the BlockManager is untouched),
+        and every step/prefill program is jitted ONCE with DECLARED
+        ``in_shardings``/``out_shardings`` and the cache still donated.
+        The Pallas decode kernel is gated off under a mesh (the XLA
+        gather path partitions under GSPMD; see
+        ``ops.attention._mesh_sharded_trace``); greedy outputs stay
+        token-identical to the single-chip engine in every layout."""
         if hasattr(model, "init_decode_state"):
             raise NotImplementedError(
                 "ServingEngine requires the stacked KV cache; recurrent "
@@ -299,6 +317,7 @@ class ServingEngine:
             self._drafter = NgramDrafter(
                 self.spec_k,
                 max_ngram=int(_flags.flag("serving_spec_ngram")))
+        self.mesh = self._resolve_mesh(mesh)
         self._init_metrics()
 
         # quantized-decode hooks, exactly as models/generation.py binds
@@ -322,21 +341,28 @@ class ServingEngine:
             cache = init_paged_kv_cache(model.config, nb, bl)
             self._tables = np.zeros((self.num_slots, self.max_blocks),
                                     np.int32)
-            # COW device copy (compiled once; only dispatched when a
-            # shared block is about to be written — see kv_cache.py).
-            # The pool is donated: the copy aliases it in place.
-            self._cow_fn = _obs.track_retraces(
-                lambda c, src, dst: c.at[:, :, dst].set(c[:, :, src]),
-                "serving.cow", labels={"engine": self._eid},
-                donate_argnums=(0,))
         else:
             cache = init_kv_cache(model.config, self.num_slots,
                                   self.max_length)
         params, cache, _ = _place_on_mesh(
             self._bind, params, cache,
             jnp.zeros((self.num_slots, 1), jnp.int32),
-            paged_cache=self.paged)
+            paged_cache=self.paged, mesh=self.mesh)
         self._params, self._cache = params, cache
+        if self.paged:
+            # COW device copy (compiled once; only dispatched when a
+            # shared block is about to be written — see kv_cache.py).
+            # The pool is donated: the copy aliases it in place.  Under
+            # a mesh the pool keeps its declared sharding through the
+            # copy (the block axis is unsharded, so a block copy never
+            # crosses devices).
+            self._cow_fn = _obs.track_retraces(
+                lambda c, src, dst: c.at[:, :, dst].set(c[:, :, src]),
+                "serving.cow", labels={"engine": self._eid},
+                donate_argnums=(0,),
+                **(self._mesh_jit_shardings(3, 1, cache_argnum=0,
+                                            with_params=False)
+                   if self.mesh is not None else {}))
 
         # host-side mirrors of the step inputs (tiny; re-uploaded per tick)
         s = self.num_slots
@@ -370,6 +396,19 @@ class ServingEngine:
         # donated input is never read again).  The graph-lint donation
         # rule (paddle_tpu/static_analysis) verifies this stays true.
         donate = {"donate_argnums": (1,)}
+        # mesh mode: the SAME once-jitted programs, now with DECLARED
+        # shardings — params/cache per decode_mesh_specs, every small
+        # operand (token/position/mask vectors, block tables, the PRNG
+        # key) replicated, tokens replicated on the way out and the
+        # cache keeping its spec.  Declaring both sides keeps the
+        # donated cache aliasable in place (in/out layouts provably
+        # match) and makes the step's sharding contract the same one
+        # mesh_preflight lints abstractly.
+        n_out = 2 + int(self.chunked) + int(self.spec)
+        step_kwargs = dict(donate)
+        if self.mesh is not None:
+            step_kwargs.update(self._mesh_jit_shardings(
+                len(self._lint_args()), n_out))
         if self.chunked:
             # chunked mode: ONE program serves every tick — num_slots
             # decode rows plus one (possibly empty) prompt chunk, chunk
@@ -385,7 +424,8 @@ class ServingEngine:
                 impl = (self._mixed_step_impl_paged if self.paged
                         else self._mixed_step_impl)
             self._step_fn = _obs.track_retraces(
-                impl, "serving.step", budget=1, labels=lbl, **donate)
+                self._under_mesh(impl), "serving.step", budget=1,
+                labels=lbl, **step_kwargs)
             self._prefill_fn = None
         else:
             if self.spec:
@@ -395,12 +435,98 @@ class ServingEngine:
                 impl = (self._step_impl_paged if self.paged
                         else self._step_impl)
             self._step_fn = _obs.track_retraces(
-                impl, "serving.step", budget=1, labels=lbl, **donate)
+                self._under_mesh(impl), "serving.step", budget=1,
+                labels=lbl, **step_kwargs)
+            prefill_kwargs = dict(donate)
+            if self.mesh is not None:
+                prefill_kwargs.update(self._mesh_jit_shardings(
+                    10 if self.paged else 9, 2))
             self._prefill_fn = _obs.track_retraces(
-                self._prefill_impl_paged if self.paged
-                else self._prefill_impl, "serving.prefill",
-                budget=_PREFILL_TRACE_BUDGET, labels=lbl, **donate)
+                self._under_mesh(self._prefill_impl_paged if self.paged
+                                 else self._prefill_impl),
+                "serving.prefill",
+                budget=_PREFILL_TRACE_BUDGET, labels=lbl,
+                **prefill_kwargs)
         self._linted = False           # first-tick self-lint (graph_lint)
+
+    # -- mesh execution (ISSUE 9) ------------------------------------------
+
+    @staticmethod
+    def _resolve_mesh(mesh):
+        """Normalise the ``mesh`` constructor argument to a concrete jax
+        ``Mesh`` or ``None`` (single-chip): ``None`` consults
+        FLAGS_serving_mesh; a ``HybridCommunicateGroup`` contributes its
+        mesh; a compact axis string like ``"mp2dp2"`` is laid over the
+        first matching prefix of ``jax.devices()``.  An all-ones mesh
+        collapses to ``None`` — placement would be a no-op."""
+        if mesh is None:
+            mesh = str(_flags.flag("serving_mesh"))
+        if mesh is None or mesh == "":
+            return None
+        m = getattr(mesh, "mesh", mesh)        # HybridCommunicateGroup
+        if isinstance(m, str):
+            from jax.sharding import Mesh
+
+            from ..static_analysis import MeshInfo
+            minfo = MeshInfo.of(m)
+            shape = tuple(n for _, n in minfo.axes)
+            need = int(np.prod(shape))
+            devs = jax.devices()
+            if need > len(devs):
+                raise ValueError(
+                    f"mesh {m!r} needs {need} devices; only "
+                    f"{len(devs)} available on this host")
+            m = Mesh(np.asarray(devs[:need]).reshape(shape), minfo.names)
+        if all(m.shape[a] == 1 for a in m.axis_names):
+            return None
+        return m
+
+    def _under_mesh(self, impl):
+        """Trace-time mesh scope for a step/prefill body: the model's
+        internal sharding constraints (``mp_layers.constrain``) and the
+        shard_map vocab lookup resolve against ``env.active_mesh()``, so
+        a mesh given only to THIS engine must be installed around the
+        trace — python bodies run at trace time only, so this costs
+        nothing per call.  Single-chip engines pass through untouched."""
+        if self.mesh is None:
+            return impl
+        from ..distributed import env as _denv
+
+        @functools.wraps(impl)
+        def traced_under_mesh(*args):
+            with _denv.use_mesh(self.mesh):
+                return impl(*args)
+        return traced_under_mesh
+
+    def _mesh_jit_shardings(self, n_args, n_out, cache_argnum=1,
+                            with_params=True):
+        """The DECLARED jit shardings of a mesh engine's program: params
+        and cache per :func:`decode_mesh_specs`, every other operand
+        replicated (token/position/mask vectors, block tables and chunk
+        scalars are tiny and every device needs them whole), sampled
+        tokens replicated on the way out with the cache keeping its
+        spec (the trailing output by convention; ``n_out == 1`` is the
+        cache-only COW copy)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        param_specs, cache_spec, _ = decode_mesh_specs(
+            self._bind, self._params, self.mesh.axis_names,
+            paged_cache=self.paged)
+
+        def ns(spec):
+            return NamedSharding(self.mesh, spec)
+
+        repl = ns(P())
+        in_sh = [repl] * n_args
+        in_sh[cache_argnum] = ns(cache_spec)
+        if with_params:
+            in_sh[0] = jax.tree_util.tree_map(ns, param_specs)
+        if n_out == 1:
+            out_sh = ns(cache_spec)
+        else:
+            out_sh = tuple([repl] * (n_out - 1) + [ns(cache_spec)])
+        return {"in_shardings": tuple(in_sh), "out_shardings": out_sh}
 
     def _init_metrics(self):
         """Declare this engine's series in the shared registry (metric
@@ -1239,6 +1365,13 @@ class ServingEngine:
         admission prefills in the same tick)."""
         return int(self._prefill is not None)
 
+    @property
+    def pending_chunks(self) -> int:
+        """Prompt chunks still to ingest (chunked mode; wave mode: 0) —
+        the capacity signal BASELINE.md names, and the load term the dp
+        replica router ranks engines by."""
+        return self._pending_chunks() if self.chunked else 0
+
     # -- static analysis (graph lint) --------------------------------------
 
     def _lint_args(self) -> Tuple:
@@ -1335,12 +1468,15 @@ class ServingEngine:
         bench rows as ``mesh_preflight``."""
         from .. import static_analysis as _sa
         if mesh is None:
+            mesh = self.mesh
+        if mesh is None:
             from ..distributed import env as _denv
             mesh = _denv.active_mesh()
             if mesh is None:
                 raise ValueError(
                     "mesh_preflight needs a mesh: pass one (e.g. "
-                    "'mp2dp2') or activate a hybrid group")
+                    "'mp2dp2'), construct the engine with mesh=..., or "
+                    "activate a hybrid group")
         minfo = _sa.MeshInfo.of(mesh)
         pf = _sa.preflight(self._step_fn, *self._lint_args(),
                            mesh=minfo, rules=rules,
@@ -1375,7 +1511,73 @@ class ServingEngine:
             "mesh.predicted_peak_hbm_bytes",
             "pre-flight predicted peak HBM per device for one step"
             ).labels(engine=self._eid).set(hbm["peak_bytes_per_device"])
+        if (self.mesh is not None
+                and minfo.axes == _sa.MeshInfo.of(self.mesh).axes):
+            pf["placement_check"] = self.mesh_placement_check(pf)
         return pf
+
+    def mesh_placement_check(self, pf) -> Dict[str, object]:
+        """Predicted-vs-ACTUAL placement cross-check for a mesh engine
+        (ISSUE 9 gauge hardening): the pre-flight's per-device HBM
+        numbers are estimates from an abstract trace; this engine's
+        params/cache are REAL ``device_put`` footprints.  Measured
+        per-device cache bytes (max over mesh devices of the placed
+        shards) must match ``hbm.cache_bytes_per_device`` within
+        FLAGS_graph_lint_hbm_tol, and measured resident bytes
+        (params + cache per device) must not exceed the predicted peak
+        beyond the same tolerance.  Drift appends a structured
+        ``hbm-liveness`` error finding to ``pf["findings"]`` — never a
+        bare assert — and the measured number lands in the registry as
+        ``mesh.measured_cache_bytes_per_device``."""
+        from .. import static_analysis as _sa
+        per_dev_cache: Dict[object, int] = {}
+        per_dev_params: Dict[object, int] = {}
+        for tree, acc in ((self._cache, per_dev_cache),
+                          (self._params, per_dev_params)):
+            for leaf in jax.tree_util.tree_leaves(tree):
+                for sh in leaf.addressable_shards:
+                    acc[sh.device] = (acc.get(sh.device, 0)
+                                      + int(sh.data.nbytes))
+        measured_cache = max(per_dev_cache.values())
+        measured_resident = max(
+            per_dev_cache.get(d, 0) + per_dev_params.get(d, 0)
+            for d in per_dev_cache)
+        hbm = pf["hbm"]
+        predicted_cache = int(hbm["cache_bytes_per_device"])
+        predicted_peak = int(hbm["peak_bytes_per_device"])
+        tol = float(_flags.flag("graph_lint_hbm_tol"))
+        rel = (abs(measured_cache - predicted_cache) / predicted_cache
+               if predicted_cache else 0.0)
+        cache_ok = rel <= tol
+        peak_ok = measured_resident <= predicted_peak * (1.0 + tol)
+        if not cache_ok:
+            pf["findings"].append(_sa.Finding(
+                "hbm-liveness", "error", "",
+                f"placed cache footprint ({measured_cache} bytes on the "
+                f"fullest device) drifts from the pre-flight prediction "
+                f"({predicted_cache}) beyond tol {tol} — the declared "
+                f"step shardings and the committed placement disagree",
+                bytes=int(abs(measured_cache - predicted_cache))))
+        if not peak_ok:
+            pf["findings"].append(_sa.Finding(
+                "hbm-liveness", "error", "",
+                f"placed resident bytes (params+cache "
+                f"{measured_resident}/device) exceed the pre-flight "
+                f"peak prediction ({predicted_peak}) beyond tol {tol} — "
+                f"the liveness estimator is missing real residency",
+                bytes=int(measured_resident - predicted_peak)))
+        _obs.default_registry().gauge(
+            "mesh.measured_cache_bytes_per_device",
+            "actual device_put cache footprint of a mesh-placed engine "
+            "(max over mesh devices)").labels(engine=self._eid).set(
+                measured_cache)
+        return {"measured_cache_bytes_per_device": int(measured_cache),
+                "predicted_cache_bytes_per_device": predicted_cache,
+                "measured_resident_bytes_per_device":
+                    int(measured_resident),
+                "predicted_peak_hbm_bytes_per_device": predicted_peak,
+                "rel_err": round(rel, 6), "tol": tol,
+                "ok": bool(cache_ok and peak_ok)}
 
     @property
     def cache_hbm_bytes(self) -> int:

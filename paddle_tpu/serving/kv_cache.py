@@ -324,6 +324,31 @@ class BlockManager:
         self._note_peak()
         return m * bl
 
+    def prefix_probe(self, prompt: Sequence[int],
+                     prompt_len: Optional[int] = None) -> int:
+        """READ-ONLY longest trie match for ``prompt``, in tokens — the
+        dp replica router's placement probe (serving/router.py): which
+        replica holds the warm blocks for this prompt?  No refcount
+        changes, no LRU touches, no counter increments — admission via
+        :meth:`admit` remains the only trie consumer with side effects.
+        Capped exactly like admission (at least one token must remain
+        to produce the first logits), so the probe never promises more
+        than admit() would adopt."""
+        if not self.prefix_cache:
+            return 0
+        n = int(prompt_len if prompt_len is not None else len(prompt))
+        bl = self.block_len
+        toks = [int(t) for t in prompt[:n]]
+        parent = _ROOT
+        m = 0
+        for b in range((n - 1) // bl):
+            bid = self._trie.get((parent, tuple(toks[b * bl:(b + 1) * bl])))
+            if bid is None:
+                break
+            m += 1
+            parent = bid
+        return m * bl
+
     def register_prompt_upto(self, slot: int, prompt: Sequence[int],
                              upto: int):
         """Chunked-prefill trie registration: insert the prompt's full

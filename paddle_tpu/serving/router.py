@@ -1,0 +1,262 @@
+"""Data-parallel replica router — N ServingEngines behind one submit().
+
+The horizontal half of ROADMAP item 1's mesh-sharded serving: the
+tensor-parallel engine step (``ServingEngine(mesh=...)``) makes ONE
+model instance span chips; this router scales *throughput* by running N
+independent engine replicas — each with its own KV cache / block pool /
+scheduler, optionally each mesh-sharded — and placing requests across
+them.  Aggregate tok/s is the sum of per-replica committed tokens
+(BASELINE.md multi-replica accounting), and the placement policy is
+what keeps that sum high:
+
+  * **prefix-affinity** (default, FLAGS_serving_router_policy): paged
+    replicas expose a READ-ONLY trie probe
+    (:meth:`~paddle_tpu.serving.kv_cache.BlockManager.prefix_probe`);
+    the router sends a prompt to the replica holding its longest
+    already-cached full-block prefix — a shared system prompt is
+    computed once on ONE replica and every later tenant request lands
+    on the warm trie instead of recomputing it cold elsewhere.  With no
+    full-block match anywhere (cold start, empty trie, contiguous
+    engines) placement falls back to **least-loaded** — queue depth +
+    pending prefill chunks (the BASELINE.md capacity signal) + busy
+    slots;
+  * **session affinity** overrides every policy: the first request of a
+    ``session`` pins the session to its replica and every later request
+    reuses it, so a conversation's decode (and its incremental prefix
+    blocks) never migrates — even across chunked-prefill ticks while an
+    earlier turn is still streaming in;
+  * **failover**: ``submit()`` tries replicas in placement order — a
+    replica whose admission rejects the request outright (pool too
+    small for the worst case) is skipped and the next candidate takes
+    it, counted in ``router.submit_failovers``.  Only when EVERY
+    replica rejects does the error propagate.
+
+Scheduling is a round-robin tick loop: ``step()`` ticks every replica
+once (an idle replica's tick returns immediately without device work),
+``drain()`` loops until all replicas are empty.  There are no router
+threads — on TPU each replica's step is an async dispatch, so one host
+thread keeps N devices busy; the loop form also keeps tests and traces
+deterministic.
+
+Telemetry rides the shared registry with per-replica labels
+(``router.requests{replica=..., route=...}``); :meth:`metrics` returns
+the per-replica engine snapshots plus the pooled aggregates (summed
+tokens, pooled prefix hit rate) the bench rows commit.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from .. import observability as _obs
+from .engine import SamplingParams, ServingEngine
+
+__all__ = ["ReplicaRouter"]
+
+_ROUTER_IDS = itertools.count()
+
+
+class ReplicaRouter:
+    """N data-parallel ServingEngine replicas behind one ``submit()``.
+
+    ``ReplicaRouter(model, num_replicas=4)`` builds the replicas (the
+    model's host-side params are shared; each replica owns its cache
+    and scheduler; ``engine_kwargs`` — ``paged``, ``chunked``,
+    ``mesh``, ... — are forwarded to every one).  Pass ``engines=[...]``
+    instead to route over pre-built, possibly heterogeneous engines.
+    """
+
+    def __init__(self, model=None, num_replicas: Optional[int] = None,
+                 *, engines: Optional[List[ServingEngine]] = None,
+                 policy: Optional[str] = None, **engine_kwargs):
+        self.policy = str(policy
+                          or _flags.flag("serving_router_policy"))
+        if self.policy not in ("prefix", "least_loaded", "round_robin"):
+            raise ValueError(
+                f"policy must be 'prefix', 'least_loaded' or "
+                f"'round_robin', got {self.policy!r}")
+        if engines is not None:
+            if model is not None or engine_kwargs:
+                raise ValueError(
+                    "pass either engines=[...] or a model (+kwargs), "
+                    "not both")
+            self.engines = list(engines)
+        else:
+            if model is None:
+                raise ValueError("a model (or engines=[...]) is required")
+            n = int(num_replicas
+                    or _flags.flag("serving_dp_replicas"))
+            if n < 1:
+                raise ValueError(f"num_replicas must be >= 1, got {n}")
+            self.engines = [ServingEngine(model, **engine_kwargs)
+                            for _ in range(n)]
+        if not self.engines:
+            raise ValueError("at least one replica is required")
+        self._rid = itertools.count()
+        # router rid -> (replica index, engine rid); insertion order IS
+        # arrival order (drain() returns it)
+        self._placed: Dict[int, Tuple[int, int]] = {}
+        self._affinity: Dict[object, int] = {}      # session -> replica
+        self._rr = 0                                # round-robin cursor
+        reg = _obs.default_registry()
+        self._router_id = str(next(_ROUTER_IDS))
+        lbl = {"router": self._router_id}
+        self._m_requests = reg.counter(
+            "router.requests",
+            "requests placed, by replica and route (prefix = warm-trie "
+            "match, affinity = session pin, least_loaded / round_robin "
+            "= the fallbacks)")
+        self._m_failovers = reg.counter(
+            "router.submit_failovers",
+            "submissions retried on another replica after the chosen "
+            "one rejected admission outright").labels(**lbl)
+        self._m_prefix_tokens = reg.counter(
+            "router.prefix_routed_tokens",
+            "prompt tokens the placement probe found already cached on "
+            "the chosen replica at submit time").labels(**lbl)
+
+    # -- placement ---------------------------------------------------------
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.engines)
+
+    @staticmethod
+    def _load(eng: ServingEngine) -> Tuple[int, int]:
+        """Replica load for the least-loaded fallback: queued requests
+        plus pending prefill chunks (the BASELINE.md capacity signal)
+        first, busy slots as the tie-breaker."""
+        return (eng.queue_depth + eng.num_pending + eng.pending_chunks,
+                eng.num_active)
+
+    def _probe(self, eng: ServingEngine, prompt: np.ndarray) -> int:
+        """Cached prefix tokens ``eng`` already holds for ``prompt``
+        (0 for contiguous / prefix-cache-off replicas)."""
+        if not eng.paged:
+            return 0
+        return int(eng.kv.prefix_probe(prompt))
+
+    def _placement_order(self, prompt: np.ndarray,
+                         session) -> List[Tuple[int, str, int]]:
+        """Candidate replicas, best first, as ``(index, route, warm)``
+        triples.  Failover walks this list in order."""
+        idx = list(range(len(self.engines)))
+        if session is not None and session in self._affinity:
+            # the session's replica first; the rest by load as failover
+            pin = self._affinity[session]
+            rest = sorted((i for i in idx if i != pin),
+                          key=lambda i: self._load(self.engines[i]))
+            return ([(pin, "affinity", self._probe(self.engines[pin],
+                                                   prompt))]
+                    + [(i, "least_loaded", 0) for i in rest])
+        if self.policy == "round_robin":
+            order = idx[self._rr:] + idx[:self._rr]
+            self._rr = (self._rr + 1) % len(idx)
+            return [(i, "round_robin", 0) for i in order]
+        loads = {i: self._load(self.engines[i]) for i in idx}
+        by_load = sorted(idx, key=lambda i: loads[i])
+        if self.policy == "least_loaded":
+            return [(i, "least_loaded", 0) for i in by_load]
+        # prefix policy: longest warm trie match wins (load breaks
+        # ties); replicas with no full-block match rank by load behind
+        # every warm one — the empty-trie cold start degenerates to
+        # pure least-loaded
+        warm = {i: self._probe(self.engines[i], prompt) for i in idx}
+        order = sorted(idx, key=lambda i: (-warm[i], loads[i]))
+        return [(i, "prefix" if warm[i] else "least_loaded", warm[i])
+                for i in order]
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               sampling: Optional[SamplingParams] = None,
+               session=None) -> int:
+        """Place and enqueue a request; returns the ROUTER request id.
+        ``session`` (any hashable) pins this and every later request of
+        the session to one replica — decode never migrates."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        last_err: Optional[Exception] = None
+        for i, route, warm in self._placement_order(prompt, session):
+            try:
+                erid = self.engines[i].submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    sampling=sampling)
+            except ValueError as e:
+                # admission rejected the request outright (e.g. the
+                # replica's pool cannot cover its worst case) — the
+                # failover clause: try the next candidate
+                last_err = e
+                self._m_failovers.inc()
+                continue
+            rid = next(self._rid)
+            self._placed[rid] = (i, erid)
+            if session is not None:
+                self._affinity.setdefault(session, i)
+            self._m_requests.labels(router=self._router_id,
+                                    replica=str(i), route=route).inc()
+            if warm:
+                self._m_prefix_tokens.inc(int(warm))
+            return rid
+        raise last_err if last_err is not None else RuntimeError(
+            "no replica accepted the request")
+
+    # -- scheduling --------------------------------------------------------
+
+    def step(self) -> List[int]:
+        """One round-robin tick over every replica (idle replicas return
+        immediately).  Returns router rids finished this tick."""
+        finished: List[int] = []
+        for i, eng in enumerate(self.engines):
+            done = set(eng.step())
+            if done:
+                finished.extend(
+                    rid for rid, (ri, erid) in self._placed.items()
+                    if ri == i and erid in done)
+        return finished
+
+    def drain(self) -> List[Tuple[int, List[int]]]:
+        """Tick until every replica is empty; returns
+        ``[(router_rid, tokens)]`` in arrival order."""
+        while any(eng.queue_depth or eng.num_active or eng.num_pending
+                  for eng in self.engines):
+            self.step()
+        return [(rid, self.result(rid)) for rid in self._placed]
+
+    def result(self, rid: int) -> List[int]:
+        i, erid = self._placed[rid]
+        return self.engines[i].result(erid)
+
+    def replica_of(self, rid: int) -> int:
+        """Which replica serves router request ``rid`` (affinity probes
+        in tests; a session's requests all map to one value)."""
+        return self._placed[rid][0]
+
+    # -- telemetry ---------------------------------------------------------
+
+    def metrics(self) -> Dict[str, object]:
+        """Per-replica engine snapshots plus the pooled aggregates
+        (BASELINE.md multi-replica accounting): aggregate tok/s derives
+        from ``tokens_generated`` summed over replicas; the pooled
+        prefix hit rate re-divides summed hit tokens by summed admitted
+        prompt tokens (NOT the mean of per-replica rates)."""
+        per = [eng.metrics() for eng in self.engines]
+        agg: Dict[str, object] = {
+            "replicas": len(self.engines),
+            "policy": self.policy,
+            "tokens_generated": sum(m["tokens_generated"] for m in per),
+            "requests_submitted": sum(m["requests_submitted"]
+                                      for m in per),
+            "requests_finished": sum(m["requests_finished"] for m in per),
+            "submit_failovers": int(self._m_failovers.value()),
+            "prefix_routed_tokens": int(self._m_prefix_tokens.value())}
+        if all(eng.paged for eng in self.engines):
+            hits = sum(eng.kv.stats["prefix_hit_tokens"]
+                       for eng in self.engines)
+            total = sum(eng.prefill_tokens_total for eng in self.engines)
+            agg["prefix_hit_rate_pooled"] = (round(hits / total, 3)
+                                             if total else 0.0)
+            agg["prefix_hit_rate_per_replica"] = [
+                m["kv_cache"]["prefix_hit_rate"] for m in per]
+        return {"aggregate": agg, "per_replica": per}

@@ -31,14 +31,17 @@ from typing import List, Optional
 
 # --json output contract: bump when the blob SHAPE changes.  v1 was the
 # unversioned ISSUE-6 {layout: [findings]} mapping; v2 nests per-layout
-# reports under "layouts" and adds the mesh pre-flight blocks.
-SCHEMA_VERSION = 2
+# reports under "layouts" and adds the mesh pre-flight blocks; v3 adds
+# the optional per-layout "execute" block (--mesh ... --execute).
+SCHEMA_VERSION = 3
 
 _EPILOG = """\
 exit status: 0 = every layout linted clean (and, with --mesh, every
-HBM cross-check passed); 1 = at least one finding; 2 = bad usage
-(argparse).  --json prints one deterministic JSON object (findings
-sorted by severity/rule/path/bytes/message, schema_version=%d) for CI
+HBM cross-check passed; with --execute, every placed step ran with
+greedy parity and no placement drift); 1 = at least one finding or
+execute failure; 2 = bad usage (argparse).  --json prints one
+deterministic JSON object (findings sorted by
+severity/rule/path/bytes/message, schema_version=%d) for CI
 artifact diffs.""" % SCHEMA_VERSION
 
 
@@ -65,11 +68,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "as <axis><size> pairs, e.g. mp2dp2 (axis "
                          "names: mp/dp/sharding/sep/pp); no devices "
                          "needed")
+    ap.add_argument("--execute", action="store_true",
+                    help="with --mesh: also RUN one mesh-placed trace "
+                         "per engine layout on this host's devices "
+                         "(ISSUE 9 smoke) — greedy outputs must be "
+                         "token-identical to the single-chip engine, "
+                         "the step must compile once, and the placed "
+                         "footprints must match the pre-flight "
+                         "prediction; any drift exits non-zero")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report instead of text "
                          "(schema_version %d; see epilog)"
                          % SCHEMA_VERSION)
     args = ap.parse_args(argv)
+    if args.execute and not args.mesh:
+        ap.error("--execute requires --mesh")
 
     import jax.numpy as jnp
 
@@ -107,7 +120,22 @@ def main(argv: Optional[List[str]] = None) -> int:
               prefill_chunk=args.prefill_chunk, spec_decode=True,
               spec_k=args.spec_k)),
     ]
-    total = 0
+    exec_trace = None
+    if args.execute:
+        import numpy as np
+        rng = np.random.RandomState(0)
+        v = model.config.vocab_size
+        shared = rng.randint(0, v, 2 * args.block_len).astype(np.int32)
+        exec_trace = [rng.randint(0, v, n).astype(np.int32)
+                      for n in (5, 9)]
+        # two shared-prefix prompts so paged layouts exercise trie
+        # adoption under the mesh too
+        exec_trace += [
+            np.concatenate([shared,
+                            rng.randint(0, v, k).astype(np.int32)])
+            for k in (3, 4)]
+
+    total = exec_failures = 0
     layouts = {}
     for name, kw in variants:
         eng = ServingEngine(model, num_slots=args.slots,
@@ -125,6 +153,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 pf["hbm"]["peak_bytes_per_device"])
             entry["cache_check"] = pf["cache_check"]
         entry["findings"] = [f.as_dict() for f in findings]
+        if args.execute:
+            entry["execute"], nfail = _execute_layout(
+                model, kw, args, exec_trace, ServingEngine)
+            exec_failures += nfail
         layouts[name] = entry
         total += len(findings)
         if not args.json:
@@ -146,13 +178,49 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "mesh": minfo.as_dict() if minfo else None,
                 "total_findings": total,
                 "layouts": layouts}
+        if args.execute:
+            blob["execute_failures"] = exec_failures
         print(json.dumps(blob, indent=1, sort_keys=True))
     elif not total:
         nrules = len(default_rule_names(mesh=minfo is not None))
         where = f" under mesh {minfo.as_dict()}" if minfo else ""
+        ran = (f"; {len(layouts) - 1} placed layouts executed with "
+               f"greedy parity" if args.execute and not exec_failures
+               else "")
         print(f"[graph-lint] 0 findings across {len(layouts)} layouts"
-              f"{where} ({nrules} rules armed)")
-    return 1 if total else 0
+              f"{where} ({nrules} rules armed){ran}")
+    return 1 if total or exec_failures else 0
+
+
+def _execute_layout(model, kw, args, trace, ServingEngine):
+    """ISSUE 9 ``--execute`` smoke for one layout: run a small fixed
+    trace through a single-chip engine and a mesh-placed engine on this
+    host's devices; the mesh engine must produce token-identical greedy
+    outputs, compile its step exactly once, pre-flight clean, and its
+    placed footprints must match the prediction (mesh_placement_check).
+    Returns the (deterministic) report block and 0/1 failures."""
+
+    def run(extra):
+        eng = ServingEngine(model, num_slots=args.slots,
+                            max_length=args.max_length, **kw, **extra)
+        rids = [eng.submit(p, max_new_tokens=4) for p in trace]
+        out = dict(eng.drain())
+        return [out[r] for r in rids], eng
+
+    try:
+        single, _ = run({})
+        placed, eng = run({"mesh": args.mesh})
+    except ValueError as e:           # e.g. not enough devices
+        return {"error": str(e)}, 1
+    pf = eng.mesh_preflight()
+    pc = pf.get("placement_check") or {}
+    entry = {"greedy_parity": bool(single == placed),
+             "step_traces": int(eng.step_traces),
+             "preflight_findings": len(pf["findings"]),
+             "placement_ok": bool(pc.get("ok", False))}
+    ok = (entry["greedy_parity"] and entry["step_traces"] == 1
+          and not pf["findings"] and entry["placement_ok"])
+    return entry, 0 if ok else 1
 
 
 def _print_layout(label, entry, findings, report):
@@ -164,6 +232,19 @@ def _print_layout(label, entry, findings, report):
         extra = (f", comm {comm} B/step, "
                  f"peak {entry['peak_hbm_bytes_per_device'] / 1e6:.2f} "
                  f"MB/device")
+    ex = entry.get("execute")
+    if ex is not None:
+        if "error" in ex:
+            extra += f"; EXECUTE FAILED: {ex['error']}"
+            status = "FINDINGS"
+        else:
+            ok = (ex["greedy_parity"] and ex["step_traces"] == 1
+                  and ex["placement_ok"])
+            extra += (f"; executed: parity={ex['greedy_parity']} "
+                      f"traces={ex['step_traces']} "
+                      f"placement_ok={ex['placement_ok']}")
+            if not ok:
+                status = "FINDINGS"
     print(f"[graph-lint] {label} (cache {cache_mb:.2f} MB{extra}): "
           f"{status}")
     if findings:

@@ -1,0 +1,153 @@
+"""dp replica router (paddle_tpu/serving/router.py).
+
+Placement properties the router exists for: prefix-affinity routing
+lands shared-prefix prompts on the replica holding the warm trie, the
+empty-trie cold start degenerates to least-loaded, a replica rejecting
+admission fails over instead of losing the request, and session
+affinity never migrates a conversation — including across chunked
+prefill ticks.  Outputs must stay token-identical to a single engine
+(placement is pure scheduling).  Heavy mesh-parity cases live in
+tests/test_serving_mesh.py's slow lane; this file is fast-lane only.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.serving import ReplicaRouter, ServingEngine
+
+MAXLEN = 64
+BL = 8           # block_len: small so short prompts span whole blocks
+
+
+@pytest.fixture(scope="module")
+def lm():
+    pt.seed(7)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    return model
+
+
+def _prompt(n, seed):
+    return np.random.RandomState(seed).randint(0, 256, n).astype(np.int32)
+
+
+def _paged_router(lm, n=2, **kw):
+    kw.setdefault("policy", "prefix")
+    return ReplicaRouter(lm, num_replicas=n, paged=True, block_len=BL,
+                        num_slots=2, max_length=MAXLEN, **kw)
+
+
+def test_cold_start_empty_trie_falls_back_to_least_loaded(lm):
+    """With every trie empty the prefix policy must degenerate to
+    least-loaded: requests spread over replicas instead of piling onto
+    replica 0 (no-match probes rank purely by load)."""
+    router = _paged_router(lm)
+    p0, p1 = _prompt(6, 1), _prompt(7, 2)
+    r0 = router.submit(p0, max_new_tokens=4)
+    # replica 0 now carries queued work; a second DISTINCT prompt must
+    # go to the idle replica
+    r1 = router.submit(p1, max_new_tokens=4)
+    assert router.replica_of(r0) != router.replica_of(r1)
+    out = dict(router.drain())
+    assert len(out[r0]) == 4 and len(out[r1]) == 4
+
+
+def test_prefix_affinity_routes_to_warm_replica_and_beats_parity(lm):
+    """A prompt sharing a >= 1-block cached prefix must land on the
+    replica that computed it (warm trie), its prefix adopted there, and
+    every output must equal the single-engine reference."""
+    router = _paged_router(lm)
+    shared = _prompt(2 * BL, 3)                     # two full blocks
+    first = np.concatenate([shared, _prompt(3, 4)])
+    r0 = router.submit(first, max_new_tokens=4)
+    router.drain()                                  # trie now warm
+    home = router.replica_of(r0)
+    # queue a cold request onto the warm replica (tie-break lands it
+    # there) so least-loaded would now steer AWAY from home — prefix
+    # affinity must win anyway
+    other = router.submit(_prompt(5, 5), max_new_tokens=6)
+    assert router.replica_of(other) == home
+    follow = np.concatenate([shared, _prompt(4, 6)])
+    r1 = router.submit(follow, max_new_tokens=4)
+    assert router.replica_of(r1) == home
+    out = dict(router.drain())
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        paged=True, block_len=BL)
+    rids = [eng.submit(p, max_new_tokens=n)
+            for p, n in ((first, 4), (_prompt(5, 5), 6), (follow, 4))]
+    ref = dict(eng.drain())
+    assert [out[r0], out[other], out[r1]] == [ref[r] for r in rids]
+    agg = router.metrics()["aggregate"]
+    assert agg["prefix_routed_tokens"] >= BL
+    assert agg["prefix_hit_rate_pooled"] > 0
+
+
+def test_submit_failover_on_replica_rejection(lm):
+    """A replica whose admission rejects the request outright (pool too
+    small for the worst case) fails over to the next candidate — even
+    when the rejecting replica held the warm prefix — and only when
+    every replica rejects does the error propagate."""
+    tiny = ServingEngine(lm, num_slots=2, max_length=MAXLEN, paged=True,
+                        block_len=BL, num_blocks=4)     # 3 usable blocks
+    big = ServingEngine(lm, num_slots=2, max_length=MAXLEN, paged=True,
+                        block_len=BL)
+    router = ReplicaRouter(engines=[tiny, big], policy="prefix")
+    shared = _prompt(BL, 7)
+    r0 = router.submit(np.concatenate([shared, _prompt(2, 8)]),
+                       max_new_tokens=2)
+    assert router.replica_of(r0) == 0               # fits the tiny pool
+    router.drain()
+    # same warm prefix, but a worst case the tiny pool cannot cover:
+    # the prefix-matched replica 0 raises, the router must fail over
+    r1 = router.submit(np.concatenate([shared, _prompt(2, 9)]),
+                       max_new_tokens=30)
+    assert router.replica_of(r1) == 1
+    agg = router.metrics()["aggregate"]
+    assert agg["submit_failovers"] >= 1
+    out = dict(router.drain())
+    assert len(out[r1]) == 30
+    # every replica rejecting propagates the admission error
+    with pytest.raises(ValueError):
+        ReplicaRouter(engines=[tiny], policy="prefix").submit(
+            _prompt(4, 10), max_new_tokens=MAXLEN - 4)
+
+
+def test_session_affinity_survives_chunked_prefill_ticks(lm):
+    """Requests of one session stay on one replica even while an
+    earlier request of the session is still chunk-prefilling there (and
+    even though least-loaded would steer the second request away)."""
+    router = ReplicaRouter(lm, num_replicas=2, policy="prefix",
+                          paged=True, block_len=BL, num_slots=2,
+                          max_length=MAXLEN, chunked=True,
+                          prefill_chunk=8)
+    long_p = _prompt(33, 11)                 # > 4 chunks of 8
+    r0 = router.submit(long_p, max_new_tokens=3, session="tenant-a")
+    home = router.replica_of(r0)
+    router.step()                            # first chunk only: still
+    eng = router.engines[home]               # mid-prefill
+    assert eng.num_pending == 1 and eng.pending_chunks >= 1
+    r1 = router.submit(_prompt(5, 12), max_new_tokens=3,
+                       session="tenant-a")
+    assert router.replica_of(r1) == home     # affinity, not least-load
+    r2 = router.submit(_prompt(5, 13), max_new_tokens=3)
+    assert router.replica_of(r2) != home     # no session: load balances
+    out = dict(router.drain())
+    assert all(len(out[r]) == 3 for r in (r0, r1, r2))
+
+
+def test_round_robin_policy_and_aggregated_metrics(lm):
+    router = ReplicaRouter(lm, num_replicas=2, policy="round_robin",
+                          num_slots=2, max_length=MAXLEN)
+    rids = [router.submit(_prompt(4 + i, 20 + i), max_new_tokens=3)
+            for i in range(4)]
+    assert [router.replica_of(r) for r in rids] == [0, 1, 0, 1]
+    out = dict(router.drain())
+    m = router.metrics()
+    assert m["aggregate"]["tokens_generated"] == sum(
+        len(v) for v in out.values()) == 12
+    assert m["aggregate"]["requests_finished"] == 4
+    assert len(m["per_replica"]) == 2
+    with pytest.raises(ValueError):
+        ReplicaRouter(lm, num_replicas=2, policy="bogus")
