@@ -966,6 +966,102 @@ def _chunked_serving_bench(model, on_tpu):
                     "conventions in BASELINE.md)"}
 
 
+def _slo_serving_bench(model, on_tpu):
+    """Goodput-under-SLO A/B (ISSUE 12): the SAME seeded heavy-tail
+    load (loadgen: Poisson arrivals, Zipf-bucketed long-prompt mix,
+    shared-prefix tenants) replayed through the wave engine and the
+    chunked mixed-step engine, judged against one (TTFT p99, TPOT p99)
+    deadline pair.  Targets are derived from the CHUNKED engine's own
+    measured pass — p99 × 1.5 headroom — then both engines' RequestLogs
+    are joined against them post hoc (slo_report with explicit
+    targets), so the comparison is one fixed ruler, not per-engine
+    flags.  The wave engine's whole-prompt prefill stalls inflate
+    in-flight requests' TPOT past the ruler; the chunked engine bounds
+    every tick, so its goodput must be strictly higher on this mix.
+    A third identical replay through each warm engine must reproduce
+    the second's timeline signature and sampled outputs exactly — the
+    seeded-loadgen determinism contract (BASELINE.md "SLO accounting
+    conventions")."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import LoadSpec, ServingEngine, generate_load
+    from paddle_tpu.serving import replay as lg_replay
+
+    if on_tpu:
+        slots, max_len, chunk, n_req = 8, 2048, 256, 32
+        buckets, out_med, out_lo, out_hi = (32, 64, 1024), 48.0, 16, 96
+    else:  # plumbing smoke: tiny trace, no perf meaning
+        slots, max_len, chunk, n_req = 4, 256, 16, 24
+        buckets, out_med, out_lo, out_hi = (8, 16, 192), 14.0, 8, 24
+    # the long-prompt mix: the top bucket is a whole-prompt prefill
+    # stall several in-flight decode lifetimes long, and zipf a=1.0
+    # gives it real mass — the HOL pressure chunked prefill exists for
+    spec = LoadSpec(
+        n_requests=n_req, vocab=model.config.vocab_size,
+        arrival="poisson", mean_gap=1.0,
+        prompt_dist="zipf", prompt_buckets=buckets, prompt_zipf_a=1.0,
+        prompt_max=max(buckets),
+        output_dist="lognormal", output_median=out_med, output_sigma=0.5,
+        output_min=out_lo, output_max=out_hi,
+        tenants=2, shared_prefix_len=4)
+    load = generate_load(spec, seed=11)
+
+    def measure(eng):
+        lg_replay(eng, load)                  # A: compile + warm
+        b = lg_replay(eng, load)              # B: steady-state measure
+        c = lg_replay(eng, load)              # C: determinism replay
+        return b, c
+
+    wave_b, wave_c = measure(
+        ServingEngine(model, num_slots=slots, max_length=max_len))
+    ck_b, ck_c = measure(
+        ServingEngine(model, num_slots=slots, max_length=max_len,
+                      chunked=True, prefill_chunk=chunk))
+    # the ruler: chunked pass-B observed p99s with 1.5x headroom
+    t_ttft = round(ck_b["slo"]["ttft_ms"]["p99"] * 1.5, 3)
+    t_tpot = round(ck_b["slo"]["tpot_ms"]["p99"] * 1.5, 3)
+    log = obs.get_request_log()
+
+    def judge(rep):
+        slo = log.slo_report(since_uid=rep["mark"],
+                             until_uid=rep["end_mark"], ttft_ms=t_ttft,
+                             tpot_ms=t_tpot, wall_s=rep["wall_s"])
+        return {"goodput": slo["goodput"],
+                "goodput_tok_s": slo["goodput_tok_s"],
+                "attained": slo["attained"],
+                "violations": slo["violations"],
+                "ttft_ms": slo["ttft_ms"], "tpot_ms": slo["tpot_ms"],
+                "rejected": rep["rejected"],
+                "generated_tokens": rep["generated_tokens"],
+                "ticks": rep["ticks"],
+                "step_traces": max(rep["step_traces"])}
+
+    wave_row, ck_row = judge(wave_b), judge(ck_b)
+    deterministic = (
+        wave_b["signature"] == wave_c["signature"]
+        and wave_b["outputs"] == wave_c["outputs"]
+        and ck_b["signature"] == ck_c["signature"]
+        and ck_b["outputs"] == ck_c["outputs"])
+    return {
+        "num_slots": slots, "max_length": max_len,
+        "prefill_chunk": chunk, "requests": n_req,
+        "load": {"arrival": "poisson, mean gap 1.0 ticks",
+                 "prompt_mix": f"zipf-bucketed {list(buckets)} a=1.0",
+                 "output_mix": f"lognormal median {out_med} "
+                               f"clamp [{out_lo},{out_hi}]",
+                 "tenants": 2, "shared_prefix_len": 4, "seed": 11},
+        "slo_targets_ms": {"ttft_p99": t_ttft, "tpot_p99": t_tpot,
+                           "rule": "chunked measured pass p99 x 1.5"},
+        "wave": wave_row,
+        "chunked": ck_row,
+        "chunked_strictly_better": ck_row["goodput"] > wave_row["goodput"],
+        "deterministic_replay": deterministic,
+        "note": "same seeded load through both engines (pass A compiles, "
+                "B measures, C replays); goodput = fraction of ALL "
+                "submitted requests (rejections included) retiring "
+                "within both deadlines, TTFT measured from submit "
+                "(BASELINE.md 'SLO accounting conventions')"}
+
+
 def _paged_serving_bench(model, on_tpu):
     """Paged-KV engine over a SHARED-PROMPT trace: every second request
     opens with the same system prompt (full KV blocks of it), so the
@@ -1383,7 +1479,7 @@ def run_decode_bench(args):
     model = params = None
     n = pbytes = 0
     if want & {"prefill", "decode", "int8", "e2e", "serving",
-               "spec_decode", "mesh_serving"}:
+               "spec_decode", "mesh_serving", "slo_serving"}:
         model, params, n = _decode_model(max_pos=8192 if on_tpu else 512,
                                          on_tpu=on_tpu)
         pbytes = n * 2                                  # bf16 weights
@@ -1553,6 +1649,17 @@ def run_decode_bench(args):
               f"{sv['mean_slot_occupancy']}, step_traces "
               f"{sv['step_traces']}", file=sys.stderr)
 
+    # -- goodput under SLO: wave vs chunked on one seeded load -----------
+    if "slo_serving" in want:
+        print("[decode-bench] slo serving A/B ...", file=sys.stderr)
+        sl = _slo_serving_bench(model, on_tpu)
+        _merge_decode_artifact(skey, {"slo_serving": sl})
+        print(f"slo_serving: goodput wave {sl['wave']['goodput']} vs "
+              f"chunked {sl['chunked']['goodput']} under TTFT p99 "
+              f"{sl['slo_targets_ms']['ttft_p99']} ms / TPOT p99 "
+              f"{sl['slo_targets_ms']['tpot_p99']} ms, deterministic "
+              f"{sl['deterministic_replay']}", file=sys.stderr)
+
     # -- speculative decoding A/B ----------------------------------------
     if "spec_decode" in want:
         print("[decode-bench] spec-decode A/B trace ...", file=sys.stderr)
@@ -1714,9 +1821,11 @@ def main():
                     help="comma list for the decode/serving harness: "
                          "prefill,decode,int8,e2e,fused (default all) "
                          "plus the opt-in continuous-batching 'serving' "
-                         "trace, the 'spec_decode' speculative A/B and "
+                         "trace, the 'spec_decode' speculative A/B, "
                          "the 'mesh_serving' mp-engine + dp-router A/B "
-                         "(needs 4+ devices; the CPU smoke fakes 8); "
+                         "(needs 4+ devices; the CPU smoke fakes 8) and "
+                         "the 'slo_serving' goodput-under-SLO wave-vs-"
+                         "chunked A/B on one seeded loadgen trace; "
                          "implies --decode")
     ap.add_argument("--no-lane", action="store_true", dest="no_lane",
                     help="skip the embedded tpu_lane correctness summary "

@@ -291,3 +291,19 @@ DEFINE("trace_buffer_events", 100000,
        "span-tracer ring-buffer capacity: a long-running server keeps "
        "the most recent window of host spans and counts the rest as "
        "dropped (SpanTracer.dropped)")
+DEFINE("request_log_max_requests", 4096,
+       "RequestLog capacity in whole requests: the per-request "
+       "lifecycle store keeps the most recent window of timelines, "
+       "evicting oldest requests first and counting them "
+       "(RequestLog.dropped), mirroring the span tracer's ring policy")
+DEFINE("serving_slo_ttft_ms", 0.0,
+       "per-request TTFT deadline in ms recorded at submit() and "
+       "joined by RequestLog.slo_report(): a request whose first token "
+       "lands later than this after SUBMIT (not admit) misses SLO, "
+       "attributed to queue_wait or prefill by the larger segment.  "
+       "0 disables the TTFT deadline")
+DEFINE("serving_slo_tpot_ms", 0.0,
+       "per-request TPOT deadline in ms recorded at submit(): a "
+       "retired request whose mean time-per-output-token exceeds this "
+       "misses SLO, attributed to decode.  0 disables the TPOT "
+       "deadline")

@@ -14,6 +14,11 @@ hardware allows") requires as a *layer*, not per-module counters:
   * :mod:`.tracing` — a host-side span tracer with Chrome-trace /
     Perfetto JSON export, composed with ``profiler.RecordEvent`` so the
     same labelled regions appear against XLA device traces;
+  * :mod:`.request_log` — per-request lifecycle timelines (submitted →
+    admitted → prefill → first token → retired) keyed by a uid minted
+    at ``submit()`` and threaded router → replica → engine → slot, with
+    Perfetto export (one named track per request) and
+    ``slo_report()`` goodput-under-deadline readout;
   * :mod:`.watchdog` — ``track_retraces``: per-call-site jit trace
     counting with a budget, generalising the engine's
     ``step_traces == 1`` contract into a reusable, CI-armed guarantee.
@@ -24,9 +29,10 @@ distinguished by labels (``engine="0"``, ``pool="1"``), never by name.
 """
 
 from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS_MS,
-                      MetricsRegistry, default_registry, prometheus_text,
-                      snapshot)
+                      MetricsRegistry, SNAPSHOT_SCHEMA_VERSION,
+                      default_registry, prometheus_text, snapshot)
 from .metrics import reset as _reset_metrics
+from .request_log import RequestLog, get_request_log
 from .tracing import (SpanTracer, export_chrome_trace, get_tracer, instant,
                       span)
 from .watchdog import (RetraceError, RetraceWarning, TrackedFunction,
@@ -34,16 +40,18 @@ from .watchdog import (RetraceError, RetraceWarning, TrackedFunction,
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
-    "LATENCY_BUCKETS_MS", "default_registry", "snapshot",
-    "prometheus_text", "reset",
+    "LATENCY_BUCKETS_MS", "SNAPSHOT_SCHEMA_VERSION", "default_registry",
+    "snapshot", "prometheus_text", "reset",
     "SpanTracer", "get_tracer", "span", "instant", "export_chrome_trace",
+    "RequestLog", "get_request_log",
     "RetraceError", "RetraceWarning", "TrackedFunction", "track_retraces",
 ]
 
 
 def reset() -> None:
-    """Clear the default registry AND the default tracer's buffer (test
-    isolation; live metric handles keep working but stop being exported
-    until re-registered)."""
+    """Clear the default registry AND the default tracer's buffer AND
+    the default request log (test isolation; live metric handles keep
+    working but stop being exported until re-registered)."""
     _reset_metrics()
     get_tracer().clear()
+    get_request_log().clear()

@@ -34,8 +34,13 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "LATENCY_BUCKETS_MS", "default_registry", "snapshot",
-           "prometheus_text", "reset"]
+           "LATENCY_BUCKETS_MS", "SNAPSHOT_SCHEMA_VERSION",
+           "default_registry", "snapshot", "prometheus_text", "reset"]
+
+# bump when the snapshot() row shape changes; consumers (bench rows, CI
+# diffs) key on it the same way static_analysis --json carries its
+# schema version, so artifact diffs are attributable
+SNAPSHOT_SCHEMA_VERSION = 1
 
 # decade-ish spread covering sub-ms kernel dispatch through multi-second
 # CPU-interpret prefills; +Inf is implicit
@@ -286,10 +291,16 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able dump of every series: counters/gauges as values,
-        histograms with count/sum/percentiles/cumulative buckets."""
+        histograms with count/sum/percentiles/cumulative buckets.
+
+        Deterministically ordered (families sorted by name, series by
+        label items, ``schema_version`` first) so two snapshots of the
+        same state serialize byte-identically — the static_analysis
+        ``--json`` convention, which keeps bench artifacts and CI diffs
+        stable across reruns."""
         with self._lock:
             families = list(self._families.values())
-        out: Dict[str, Any] = {}
+        out: Dict[str, Any] = {"schema_version": SNAPSHOT_SCHEMA_VERSION}
         for fam in sorted(families, key=lambda f: f.name):
             series = []
             for child in fam.children():
@@ -321,7 +332,7 @@ class MetricsRegistry:
             if fam.kind == "counter":
                 base += "_total"
             if fam.help:
-                lines.append(f"# HELP {base} {fam.help}")
+                lines.append(f"# HELP {base} {_expo_help(fam.help)}")
             lines.append(f"# TYPE {base} {fam.kind}")
             for child in fam.children():
                 if fam.kind == "histogram":
@@ -342,15 +353,26 @@ def _expo_name(name: str, prefix: str) -> str:
     return f"{prefix}_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
 
+def _expo_help(text: str) -> str:
+    # exposition format: HELP text escapes backslash and newline (a raw
+    # newline would terminate the comment mid-text and corrupt the scrape)
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _expo_value(v: str) -> str:
+    # label values escape backslash, newline AND double-quote
+    return (v.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
 def _expo_labels(labels: Dict[str, str], le: Optional[str] = None) -> str:
     items = sorted(labels.items())
     if le is not None:
         items.append(("le", le))
     if not items:
         return ""
-    body = ",".join(
-        '{}="{}"'.format(k, v.replace("\\", "\\\\").replace('"', '\\"'))
-        for k, v in items)
+    body = ",".join('{}="{}"'.format(k, _expo_value(v))
+                    for k, v in items)
     return "{" + body + "}"
 
 

@@ -118,7 +118,29 @@ class SpanTracer:
             if len(self._events) >= self.max_events:
                 self._events.popleft()
                 self.dropped += 1
+                overflowed = True
+            else:
+                overflowed = False
             self._events.append(ev)
+        if overflowed:
+            self._mirror_dropped()
+
+    def _mirror_dropped(self) -> None:
+        """Publish ``dropped`` as the ``obs.trace_dropped_events``
+        gauge so a wrapped ring can't masquerade as a complete timeline
+        in ``snapshot()`` — previously it was counted in the
+        ``export_chrome_trace`` metadata only.  Only the process-default
+        tracer publishes: private tracers in tests must not clobber the
+        fleet count.  Called outside the ring lock (the registry has its
+        own)."""
+        if _tracer is not self:
+            return
+        from .metrics import default_registry
+        default_registry().gauge(
+            "obs.trace_dropped_events",
+            "span-tracer ring evictions since start/reset; nonzero "
+            "means exported timelines are a recent-window suffix, not "
+            "the whole story").set(float(self.dropped))
 
     # -- readout -----------------------------------------------------------
 
@@ -130,6 +152,9 @@ class SpanTracer:
         with self._lock:
             self._events.clear()
             self.dropped = 0
+        # re-register the gauge at 0 so every snapshot() taken after a
+        # reset still carries the (zero) drop count
+        self._mirror_dropped()
 
     def export_chrome_trace(self, path: Optional[str] = None
                             ) -> Dict[str, Any]:
@@ -168,6 +193,7 @@ def get_tracer() -> SpanTracer:
         with _tracer_lock:
             if _tracer is None:
                 _tracer = SpanTracer()
+                _tracer._mirror_dropped()
     return _tracer
 
 

@@ -14,7 +14,9 @@ admits queued requests into freed slots mid-flight.
 from .drafter import NgramDrafter
 from .engine import Request, SamplingParams, ServingEngine
 from .kv_cache import BlockManager, init_paged_kv_cache
+from .loadgen import LoadRequest, LoadSpec, generate_load, replay
 from .router import ReplicaRouter
 
 __all__ = ["ServingEngine", "SamplingParams", "Request", "BlockManager",
-           "init_paged_kv_cache", "NgramDrafter", "ReplicaRouter"]
+           "init_paged_kv_cache", "NgramDrafter", "ReplicaRouter",
+           "LoadRequest", "LoadSpec", "generate_load", "replay"]

@@ -182,6 +182,16 @@ class SamplingParams:
     top_p: float = 1.0
 
 
+class _Rejected(Exception):
+    """Internal admission rejection: pairs the user-facing ValueError
+    message with a stable machine-readable reason for the lifecycle
+    log (``rejected`` event / ``slo_violations{kind="rejected"}``)."""
+
+    def __init__(self, reason: str, msg: str):
+        super().__init__(msg)
+        self.reason = reason
+
+
 @dataclasses.dataclass
 class Request:
     """A queued generation request (created by ``submit``)."""
@@ -191,6 +201,11 @@ class Request:
     max_new_tokens: int
     sampling: SamplingParams
     t_submit: float = 0.0              # perf_counter at submit (SLO clock)
+    uid: int = -1                      # RequestLog correlation uid
+    t_admit: float = 0.0               # perf_counter at admission
+    ttft_slo_ms: float = 0.0           # deadlines recorded at submit;
+    tpot_slo_ms: float = 0.0           # 0 = that deadline disabled
+    blocked_ticks: int = 0             # pool-full admission deferrals
 
 
 @dataclasses.dataclass
@@ -200,6 +215,8 @@ class _Slot:
     t_first: float = 0.0               # perf_counter at first token (TPOT)
     # the request's prompt — the self-drafter's lookup corpus (spec mode)
     prompt: Optional[np.ndarray] = None
+    # the originating request — retirement reads its uid + SLO deadlines
+    req: Optional[Request] = None
 
 
 @dataclasses.dataclass
@@ -536,6 +553,8 @@ class ServingEngine:
         reg = _obs.default_registry()
         self._eid = str(next(_ENGINE_IDS))
         self._tracer = _obs.get_tracer()
+        self._rlog = _obs.get_request_log()
+        self._uids: Dict[int, int] = {}    # engine rid -> lifecycle uid
         lbl = {"engine": self._eid}
         hist, ctr, gauge = reg.histogram, reg.counter, reg.gauge
         self._m_queue_wait = hist(
@@ -567,6 +586,12 @@ class ServingEngine:
         self._f_retired = ctr(
             "serving.retired",
             "retirements by reason: eos | max_new_tokens | max_length")
+        self._f_slo_viol = ctr(
+            "serving.slo_violations",
+            "requests that missed their recorded TTFT/TPOT deadline, by "
+            "attributed cause: rejected (admission refused) | queue_wait "
+            "| prefill (missed TTFT, split by larger segment) | decode "
+            "(missed TPOT); BASELINE.md 'SLO accounting conventions'")
         self._m_tokens = ctr(
             "serving.tokens_generated",
             "sampled tokens returned to requests (prefill first tokens "
@@ -869,34 +894,71 @@ class ServingEngine:
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: int = 32,
-               sampling: Optional[SamplingParams] = None) -> int:
+               sampling: Optional[SamplingParams] = None,
+               request_uid: Optional[int] = None) -> int:
         """Enqueue a request; returns its id.  Admission happens inside
-        ``step()`` as slots free up (FIFO)."""
+        ``step()`` as slots free up (FIFO).
+
+        ``request_uid`` threads an existing lifecycle uid through (a
+        router minted it and already logged ``submitted``); direct
+        callers leave it None and the engine mints one — either way the
+        uid correlates every later lifecycle event, across replicas on
+        failover included."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if prompt.size < 1:
-            raise ValueError("prompt must contain at least one token")
-        if max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        if prompt.size + max_new_tokens > self.max_length:
-            raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds the engine's max_length "
-                f"({self.max_length})")
-        if self.paged:
-            need = self.kv.blocks_needed(prompt.size, max_new_tokens)
-            if need > self.kv.usable_blocks:
-                raise ValueError(
-                    f"request needs {need} KV blocks but the pool only "
-                    f"has {self.kv.usable_blocks} usable blocks")
+        if request_uid is None:
+            uid = self._rlog.new_uid()
+            self._rlog.event(
+                uid, "submitted", engine=self._eid,
+                prompt_len=int(prompt.size),
+                max_new_tokens=int(max_new_tokens),
+                ttft_slo_ms=float(_flags.flag("serving_slo_ttft_ms")),
+                tpot_slo_ms=float(_flags.flag("serving_slo_tpot_ms")))
+        else:
+            uid = int(request_uid)
+        try:
+            if prompt.size < 1:
+                raise _Rejected("bad_prompt",
+                                "prompt must contain at least one token")
+            if max_new_tokens < 1:
+                raise _Rejected(
+                    "bad_max_new_tokens",
+                    f"max_new_tokens must be >= 1, got {max_new_tokens}")
+            if prompt.size + max_new_tokens > self.max_length:
+                raise _Rejected(
+                    "too_long",
+                    f"prompt ({prompt.size}) + max_new_tokens "
+                    f"({max_new_tokens}) exceeds the engine's max_length "
+                    f"({self.max_length})")
+            if self.paged:
+                need = self.kv.blocks_needed(prompt.size, max_new_tokens)
+                if need > self.kv.usable_blocks:
+                    raise _Rejected(
+                        "pool_too_small",
+                        f"request needs {need} KV blocks but the pool "
+                        f"only has {self.kv.usable_blocks} usable blocks")
+        except _Rejected as e:
+            self._rlog.event(uid, "rejected", engine=self._eid,
+                             reason=e.reason)
+            self._f_slo_viol.labels(engine=self._eid,
+                                    kind="rejected").inc()
+            raise ValueError(str(e)) from None
         rid = self._next_rid
         self._next_rid += 1
         self._results[rid] = []
-        self._queue.append(Request(rid, prompt, int(max_new_tokens),
-                                   sampling or SamplingParams(),
-                                   t_submit=time.perf_counter()))
+        self._uids[rid] = uid
+        self._queue.append(Request(
+            rid, prompt, int(max_new_tokens),
+            sampling or SamplingParams(),
+            t_submit=time.perf_counter(), uid=uid,
+            ttft_slo_ms=float(_flags.flag("serving_slo_ttft_ms")),
+            tpot_slo_ms=float(_flags.flag("serving_slo_tpot_ms"))))
         self._m_submitted.inc()
         return rid
+
+    def request_uid(self, rid: int) -> int:
+        """The lifecycle uid behind engine request ``rid`` — the key
+        into :func:`paddle_tpu.observability.get_request_log`."""
+        return self._uids[rid]
 
     def step(self) -> List[int]:
         """One scheduler tick: admit queued requests into free slots
@@ -1106,6 +1168,10 @@ class ServingEngine:
             slot.remaining -= take
             self._m_tokens.inc(take)
             self._m_spec_accept.observe(take)
+            if drafted and slot.req is not None:
+                self._rlog.event(slot.req.uid, "spec_accept",
+                                 engine=self._eid, tokens=int(take),
+                                 drafted=int(drafted))
             if drafted:
                 # hits = committed draft tokens (the bonus token is free
                 # either way); misses = drafts verification rejected —
@@ -1276,12 +1342,24 @@ class ServingEngine:
                 self._m_blocked.inc()
                 self._tracer.instant("serving.admission_blocked",
                                      rid=req.request_id)
+                req.blocked_ticks += 1
+                if req.blocked_ticks == 1:
+                    # the preemption-relevant wait: log once per wait
+                    # episode, not per blocked tick
+                    self._rlog.event(req.uid, "admission_wait",
+                                     engine=self._eid, reason="pool_full")
                 return []
             m = got                  # adopted prefix tokens skip compute
         self._queue.popleft()
-        self._m_queue_wait.observe(
-            (time.perf_counter() - req.t_submit) * 1e3)
+        now = time.perf_counter()
+        req.t_admit = now
+        self._m_queue_wait.observe((now - req.t_submit) * 1e3)
         self._m_prefill_total.inc(int(req.prompt.size))
+        self._rlog.event(req.uid, "admitted", engine=self._eid,
+                         slot=int(si),
+                         queue_wait_ms=(now - req.t_submit) * 1e3,
+                         blocked_ticks=int(req.blocked_ticks),
+                         prefix_hit_tokens=int(m))
         self._prefill = _Prefill(req, si, int(m))
         return []
 
@@ -1294,6 +1372,8 @@ class ServingEngine:
         self._m_chunks.inc()
         self._m_chunk_tokens.inc(clen)
         self._m_prefill_computed.inc(clen)
+        self._rlog.event(pf.req.uid, "prefill_chunk", engine=self._eid,
+                         tokens=int(clen), cursor=int(pf.cursor))
         if self.paged:
             # register the now-written full blocks for prefix sharing —
             # never earlier: an unwritten block must not satisfy a lookup
@@ -1304,7 +1384,7 @@ class ServingEngine:
         si, req = pf.slot, pf.req
         self._prefill = None
         slot = _Slot(req.request_id, req.max_new_tokens - 1, t_first=now,
-                     prompt=req.prompt)
+                     prompt=req.prompt, req=req)
         self._slots[si] = slot
         self._active[si] = True
         self._tokens[si] = ctok
@@ -1317,6 +1397,8 @@ class ServingEngine:
         self._results[req.request_id].append(ctok)
         self._m_tokens.inc()
         self._m_ttft.observe((now - req.t_submit) * 1e3)
+        self._rlog.event(req.uid, "first_token", engine=self._eid,
+                         ttft_ms=(now - req.t_submit) * 1e3)
         reason = self._finish_reason(ctok, slot, si)
         if reason is not None:
             self._retire(slot, si, reason, now)
@@ -1643,7 +1725,11 @@ class ServingEngine:
                "tokens_generated": int(self._m_tokens.value()),
                "prefill_waves": int(self._m_waves.value()),
                "step_traces": self.step_traces,
-               "prefill_traces": self.prefill_traces}
+               "prefill_traces": self.prefill_traces,
+               "slo_violations": {
+                   str(c.labels["kind"]): int(c.value())
+                   for c in self._f_slo_viol.children()
+                   if c.labels.get("engine") == self._eid}}
         if self.chunked:
             out["chunked"] = {
                 "prefill_chunk": self.prefill_chunk,
@@ -1692,11 +1778,45 @@ class ServingEngine:
         TPOT = decode time per token after the first (prefill excluded),
         the complement of TTFT in the usual serving-latency split."""
         n = len(self._results[slot.rid])
+        tpot = None
         if n > 1 and slot.t_first > 0.0:
-            self._m_tpot.observe((now - slot.t_first) * 1e3 / (n - 1))
+            tpot = (now - slot.t_first) * 1e3 / (n - 1)
+            self._m_tpot.observe(tpot)
         self._m_finished.inc()
         self._f_retired.labels(engine=self._eid, reason=reason).inc()
+        req = slot.req
+        if req is not None:
+            ttft = ((slot.t_first - req.t_submit) * 1e3
+                    if slot.t_first > 0.0 else None)
+            kind = self._slo_violation(req, ttft, tpot)
+            if kind is not None:
+                self._f_slo_viol.labels(engine=self._eid, kind=kind).inc()
+            self._rlog.event(
+                req.uid, "retired", engine=self._eid, reason=reason,
+                tokens=int(n),
+                ttft_ms=(round(ttft, 6) if ttft is not None else None),
+                tpot_ms=(round(tpot, 6) if tpot is not None else None),
+                violation=kind or "none")
         self._release(i)
+
+    @staticmethod
+    def _slo_violation(req: Request, ttft: Optional[float],
+                       tpot: Optional[float]) -> Optional[str]:
+        """Attribute a retired request's SLO miss to ONE cause
+        (BASELINE.md "SLO accounting conventions"): a missed TTFT
+        (measured from SUBMIT, not admit) splits by the larger segment
+        — ``queue_wait`` (submit → admission) vs ``prefill`` (admission
+        → first token); otherwise a missed TPOT is ``decode``.  A
+        disabled deadline (target 0) never violates."""
+        if req.ttft_slo_ms > 0 and ttft is not None \
+                and ttft > req.ttft_slo_ms:
+            qw = ((req.t_admit - req.t_submit) * 1e3
+                  if req.t_admit > 0.0 else 0.0)
+            return "queue_wait" if qw >= ttft - qw else "prefill"
+        if req.tpot_slo_ms > 0 and tpot is not None \
+                and tpot > req.tpot_slo_ms:
+            return "decode"
+        return None
 
     # -- scheduler internals ----------------------------------------------
 
@@ -1755,6 +1875,11 @@ class ServingEngine:
                     self._m_blocked.inc()
                     self._tracer.instant("serving.admission_blocked",
                                          rid=req.request_id)
+                    req.blocked_ticks += 1
+                    if req.blocked_ticks == 1:
+                        self._rlog.event(req.uid, "admission_wait",
+                                         engine=self._eid,
+                                         reason="pool_full")
                     break
                 self._queue.popleft()
                 self._tables[si] = self.kv.table_row(si, self.max_blocks)
@@ -1791,6 +1916,15 @@ class ServingEngine:
             self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
             self._m_prefill_computed.inc(int(suffix.size))
             self._m_prefill_total.inc(int(req.prompt.size))
+            req.t_admit = t_adm
+            self._rlog.event(req.uid, "admitted", engine=self._eid,
+                             slot=int(si),
+                             queue_wait_ms=(t_adm - req.t_submit) * 1e3,
+                             blocked_ticks=int(req.blocked_ticks),
+                             prefix_hit_tokens=int(m))
+            self._rlog.event(req.uid, "prefill", engine=self._eid,
+                             bucket=int(bucket),
+                             tokens=int(suffix.size))
         self._m_waves.inc()
         self._f_bucket.labels(engine=self._eid, bucket=str(bucket)).inc()
         self._ticks += 1
@@ -1807,7 +1941,7 @@ class ServingEngine:
         finished: List[int] = []
         for r, (req, si, m) in enumerate(wave):
             slot = _Slot(req.request_id, req.max_new_tokens - 1,
-                         t_first=t_tok, prompt=req.prompt)
+                         t_first=t_tok, prompt=req.prompt, req=req)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = tok[r]
@@ -1818,6 +1952,8 @@ class ServingEngine:
             self._results[req.request_id].append(int(tok[r]))
             self._m_tokens.inc()
             self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
+            self._rlog.event(req.uid, "first_token", engine=self._eid,
+                             ttft_ms=(t_tok - req.t_submit) * 1e3)
             reason = self._finish_reason(int(tok[r]), slot, si)
             if reason is not None:
                 finished.append(req.request_id)
@@ -1845,6 +1981,15 @@ class ServingEngine:
             self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
             self._m_prefill_computed.inc(int(req.prompt.size))
             self._m_prefill_total.inc(int(req.prompt.size))
+            req.t_admit = t_adm
+            self._rlog.event(req.uid, "admitted", engine=self._eid,
+                             slot=int(si),
+                             queue_wait_ms=(t_adm - req.t_submit) * 1e3,
+                             blocked_ticks=int(req.blocked_ticks),
+                             prefix_hit_tokens=0)
+            self._rlog.event(req.uid, "prefill", engine=self._eid,
+                             bucket=int(bucket),
+                             tokens=int(req.prompt.size))
         self._m_waves.inc()
         self._f_bucket.labels(engine=self._eid, bucket=str(bucket)).inc()
         self._ticks += 1
@@ -1861,7 +2006,7 @@ class ServingEngine:
         finished: List[int] = []
         for r, (req, si) in enumerate(zip(wave, slots)):
             slot = _Slot(req.request_id, req.max_new_tokens - 1,
-                         t_first=t_tok, prompt=req.prompt)
+                         t_first=t_tok, prompt=req.prompt, req=req)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = tok[r]
@@ -1872,6 +2017,8 @@ class ServingEngine:
             self._results[req.request_id].append(int(tok[r]))
             self._m_tokens.inc()
             self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
+            self._rlog.event(req.uid, "first_token", engine=self._eid,
+                             ttft_ms=(t_tok - req.t_submit) * 1e3)
             reason = self._finish_reason(int(tok[r]), slot, si)
             if reason is not None:
                 finished.append(req.request_id)

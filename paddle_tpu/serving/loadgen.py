@@ -1,0 +1,305 @@
+"""Trace-driven load harness: seeded arrival processes + length mixes
+replayed deterministically against a ServingEngine or ReplicaRouter.
+
+Raw tok/s on a drain()-until-empty batch says nothing about production
+serving, which is judged on goodput under SLO — requests finishing
+within TTFT/TPOT deadlines under realistic traffic.  This module
+supplies the traffic half of that judgment:
+
+  * **arrival processes** — seeded Poisson (exponential inter-arrival
+    gaps) and bursty on/off (Markov-modulated: dense arrivals inside
+    ``burst_on``-tick windows separated by silent ``burst_off`` gaps),
+    both in scheduler-tick time so replays are device-speed-independent;
+  * **length mixes** — heavy-tail prompt/output lengths, either
+    lognormal (median × e^{σZ}, clamped) or Zipf-bucketed (a fixed
+    bucket ladder with rank-``a`` power-law mass — the multi-workload
+    mixture shape real traces show);
+  * **tenant populations** — Zipf-popular tenants, each with a shared
+    prompt prefix (its "system prompt"), so prefix caching and
+    prefix-affinity routing see the traffic they were built for.
+
+``generate_load(spec, seed)`` is a pure function of its arguments —
+the SAME (spec, seed) yields the SAME trace, byte for byte —  and
+``replay`` drives the trace through ``submit()``/``step()`` ticks,
+segmenting the process-wide RequestLog with ``mark()`` and returning
+outputs, the goodput report, and the run's structural
+``timeline_signature``.  Two identical-seed replays against
+identically-configured engines must produce identical signatures AND
+identical sampled outputs (BASELINE.md "SLO accounting conventions");
+``python -m paddle_tpu.serving.loadgen --smoke`` enforces exactly that,
+plus the step retrace budget, against both engine modes on CPU — the
+CI hook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import observability as _obs
+
+__all__ = ["LoadRequest", "LoadSpec", "generate_load", "replay"]
+
+
+@dataclasses.dataclass
+class LoadRequest:
+    """One request of a generated trace."""
+
+    index: int                  # position in the trace (stable id)
+    arrival: float              # arrival time, in scheduler ticks
+    tenant: int                 # which shared-prefix population
+    prompt: np.ndarray          # (plen,) int32, tenant prefix included
+    max_new_tokens: int
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """Everything that shapes a trace; with the seed, it IS the trace."""
+
+    n_requests: int = 16
+    vocab: int = 256
+
+    # arrival process (tick time)
+    arrival: str = "poisson"            # "poisson" | "bursty"
+    mean_gap: float = 1.0               # poisson: mean inter-arrival gap
+    burst_on: float = 4.0               # bursty: window of dense arrivals
+    burst_off: float = 16.0             # bursty: silent gap between windows
+    burst_gap: float = 0.25             # bursty: mean gap inside a window
+
+    # prompt length mix
+    prompt_dist: str = "lognormal"      # "lognormal" | "zipf"
+    prompt_median: float = 32.0         # lognormal median
+    prompt_sigma: float = 0.6           # lognormal log-space sigma
+    prompt_buckets: Tuple[int, ...] = (8, 16, 32, 64, 128)
+    prompt_zipf_a: float = 1.2          # bucket rank exponent
+    prompt_min: int = 2
+    prompt_max: int = 128
+
+    # output length mix (same knobs, own values)
+    output_dist: str = "lognormal"
+    output_median: float = 16.0
+    output_sigma: float = 0.6
+    output_buckets: Tuple[int, ...] = (4, 8, 16, 32, 64)
+    output_zipf_a: float = 1.2
+    output_min: int = 2
+    output_max: int = 64
+
+    # tenant population: Zipf-popular tenants sharing a prompt prefix
+    tenants: int = 1
+    tenant_zipf_a: float = 1.2
+    shared_prefix_len: int = 0
+
+
+def _lengths(rng: np.random.RandomState, n: int, dist: str,
+             median: float, sigma: float, buckets: Sequence[int],
+             zipf_a: float, lo: int, hi: int) -> np.ndarray:
+    if dist == "lognormal":
+        vals = np.exp(rng.normal(np.log(median), sigma, n))
+    elif dist == "zipf":
+        ranks = np.arange(1, len(buckets) + 1, dtype=np.float64)
+        p = ranks ** -zipf_a
+        p /= p.sum()
+        vals = np.asarray(buckets)[rng.choice(len(buckets), n, p=p)]
+    else:
+        raise ValueError(f"unknown length distribution {dist!r}")
+    return np.clip(np.round(vals).astype(np.int64), lo, hi)
+
+
+def _arrivals(rng: np.random.RandomState, spec: LoadSpec) -> np.ndarray:
+    n = spec.n_requests
+    if spec.arrival == "poisson":
+        return np.cumsum(rng.exponential(spec.mean_gap, n))
+    if spec.arrival != "bursty":
+        raise ValueError(f"unknown arrival process {spec.arrival!r}")
+    # on/off: walk burst windows, filling each with exponential gaps
+    # until its ``burst_on`` budget is spent, then jump ``burst_off``
+    out = np.empty((n,))
+    t = window_start = 0.0
+    for i in range(n):
+        t += float(rng.exponential(spec.burst_gap))
+        if t - window_start > spec.burst_on:
+            window_start = window_start + spec.burst_on + spec.burst_off
+            t = window_start + float(rng.exponential(spec.burst_gap))
+        out[i] = t
+    return out
+
+
+def generate_load(spec: LoadSpec, seed: int = 0) -> List[LoadRequest]:
+    """Materialise a trace: deterministic in (spec, seed), independent
+    of any engine or device state."""
+    rng = np.random.RandomState(seed)
+    arrivals = _arrivals(rng, spec)
+    plens = _lengths(rng, spec.n_requests, spec.prompt_dist,
+                     spec.prompt_median, spec.prompt_sigma,
+                     spec.prompt_buckets, spec.prompt_zipf_a,
+                     spec.prompt_min, spec.prompt_max)
+    olens = _lengths(rng, spec.n_requests, spec.output_dist,
+                     spec.output_median, spec.output_sigma,
+                     spec.output_buckets, spec.output_zipf_a,
+                     spec.output_min, spec.output_max)
+    ranks = np.arange(1, max(1, spec.tenants) + 1, dtype=np.float64)
+    tp = ranks ** -spec.tenant_zipf_a
+    tp /= tp.sum()
+    tenants = rng.choice(len(ranks), spec.n_requests, p=tp)
+    prefixes = rng.randint(0, spec.vocab,
+                           (max(1, spec.tenants),
+                            max(0, spec.shared_prefix_len))
+                           ).astype(np.int32)
+    load: List[LoadRequest] = []
+    for i in range(spec.n_requests):
+        body = rng.randint(0, spec.vocab, int(plens[i])).astype(np.int32)
+        prompt = np.concatenate([prefixes[int(tenants[i])], body])
+        load.append(LoadRequest(index=i, arrival=float(arrivals[i]),
+                                tenant=int(tenants[i]), prompt=prompt,
+                                max_new_tokens=int(olens[i])))
+    return load
+
+
+def replay(target, load: Sequence[LoadRequest],
+           max_ticks: Optional[int] = None) -> Dict[str, Any]:
+    """Drive a trace through ``target`` (ServingEngine or
+    ReplicaRouter): each loop iteration submits every request whose
+    arrival tick has come, then runs one ``step()``, until the trace is
+    exhausted and the target is idle.  Arrival time is tick time — the
+    replay schedule is identical however fast the device steps, which
+    is what makes two identical-seed runs comparable event-for-event.
+
+    Returns outputs (trace order; None = rejected), the segment's
+    goodput report against the deadlines recorded at submit, the
+    structural timeline signature, and per-engine step retrace counts.
+    """
+    log = _obs.get_request_log()
+    mark = log.mark()
+    engines = list(getattr(target, "engines", [target]))
+
+    def busy() -> bool:
+        return any(e.queue_depth or e.num_active or e.num_pending
+                   for e in engines)
+
+    order = sorted(range(len(load)),
+                   key=lambda i: (load[i].arrival, load[i].index))
+    rids: Dict[int, int] = {}           # trace index -> target rid
+    rejected = 0
+    tick = 0
+    nxt = 0
+    t0 = time.perf_counter()
+    while nxt < len(order) or busy():
+        while nxt < len(order) and load[order[nxt]].arrival <= tick:
+            r = load[order[nxt]]
+            try:
+                rids[r.index] = target.submit(
+                    r.prompt, max_new_tokens=r.max_new_tokens)
+            except ValueError:
+                rejected += 1
+            nxt += 1
+        target.step()
+        tick += 1
+        if max_ticks is not None and tick >= max_ticks:
+            break
+    wall = time.perf_counter() - t0
+    end_mark = log.mark()
+    outputs = [target.result(rids[r.index]) if r.index in rids else None
+               for r in load]
+    generated = sum(len(o) for o in outputs if o)
+    return {
+        "requests": len(load),
+        "rejected": rejected,
+        "ticks": tick,
+        "wall_s": wall,
+        "outputs": outputs,
+        "generated_tokens": generated,
+        "step_traces": [int(getattr(e, "step_traces", 0))
+                        for e in engines],
+        "slo": log.slo_report(since_uid=mark, until_uid=end_mark,
+                              wall_s=wall),
+        "signature": log.timeline_signature(since_uid=mark,
+                                            until_uid=end_mark),
+        # the (mark, end_mark] bracket scopes any post-hoc RequestLog
+        # readout — slo_report with explicit targets, Perfetto export —
+        # to exactly this run
+        "mark": mark,
+        "end_mark": end_mark,
+    }
+
+
+# -- CI smoke ----------------------------------------------------------------
+
+def _smoke() -> int:
+    """Tiny seeded load against BOTH engine modes (wave and chunked),
+    each replayed twice on fresh engines: non-zero exit on a step
+    retrace past budget 1 or on any determinism drift (signature or
+    sampled outputs) between the identical-seed runs."""
+    import json
+
+    import jax
+    # the env var alone is not enough where a sitecustomize pins
+    # jax_platforms; the config API wins
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    from .engine import ServingEngine
+
+    pt.seed(7)
+    model = LlamaForCausalLM(tiny_llama_config())
+    model.eval()
+    spec = LoadSpec(n_requests=8, arrival="poisson", mean_gap=1.5,
+                    prompt_dist="zipf", prompt_buckets=(8, 16, 32, 48),
+                    prompt_zipf_a=1.1, prompt_max=48,
+                    output_dist="lognormal", output_median=6.0,
+                    output_sigma=0.4, output_min=3, output_max=10,
+                    tenants=2, shared_prefix_len=4)
+    load = generate_load(spec, seed=11)
+
+    modes = {"wave": {}, "chunked": {"chunked": True, "prefill_chunk": 8}}
+    failures: List[str] = []
+    summary: Dict[str, Any] = {"requests": spec.n_requests}
+    for mode, kw in modes.items():
+        runs = []
+        for _ in range(2):
+            eng = ServingEngine(model, num_slots=4, max_length=128,
+                                prefill_batch=2, **kw)
+            runs.append(replay(eng, load))
+        a, b = runs
+        traces = max(max(r["step_traces"]) for r in runs)
+        if traces > 1:
+            failures.append(f"{mode}: step retraced (traces={traces})")
+        if a["signature"] != b["signature"]:
+            failures.append(f"{mode}: timeline signature drift between "
+                            f"identical-seed runs")
+        if a["outputs"] != b["outputs"]:
+            failures.append(f"{mode}: sampled-output drift between "
+                            f"identical-seed runs")
+        summary[mode] = {
+            "ticks": a["ticks"],
+            "generated_tokens": a["generated_tokens"],
+            "step_traces": traces,
+            "goodput": a["slo"]["goodput"],
+            "deterministic": (a["signature"] == b["signature"]
+                              and a["outputs"] == b["outputs"])}
+    summary["failures"] = failures
+    print(json.dumps(summary, indent=2))
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving.loadgen",
+        description="trace-driven serving load harness")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny seeded load against both engine modes on "
+                         "CPU; exits non-zero on retrace-budget or "
+                         "determinism drift (the CI hook)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return _smoke()
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -104,6 +104,8 @@ class ReplicaRouter:
         self._rr = 0                                # round-robin cursor
         reg = _obs.default_registry()
         self._router_id = str(next(_ROUTER_IDS))
+        self._rlog = _obs.get_request_log()
+        self._uids: Dict[int, int] = {}     # router rid -> lifecycle uid
         lbl = {"router": self._router_id}
         self._m_requests = reg.counter(
             "router.requests",
@@ -177,12 +179,23 @@ class ReplicaRouter:
         ``session`` (any hashable) pins this and every later request of
         the session to one replica — decode never migrates."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        # the lifecycle uid is minted HERE, before placement, and the
+        # same uid rides through every replica attempt — on failover the
+        # rejecting replica's "rejected" and the accepting replica's
+        # "admitted" land on one timeline
+        uid = self._rlog.new_uid()
+        self._rlog.event(
+            uid, "submitted", router=self._router_id,
+            prompt_len=int(prompt.size),
+            max_new_tokens=int(max_new_tokens),
+            ttft_slo_ms=float(_flags.flag("serving_slo_ttft_ms")),
+            tpot_slo_ms=float(_flags.flag("serving_slo_tpot_ms")))
         last_err: Optional[Exception] = None
         for i, route, warm in self._placement_order(prompt, session):
             try:
                 erid = self.engines[i].submit(
                     prompt, max_new_tokens=max_new_tokens,
-                    sampling=sampling)
+                    sampling=sampling, request_uid=uid)
             except ValueError as e:
                 # admission rejected the request outright (e.g. the
                 # replica's pool cannot cover its worst case) — the
@@ -192,6 +205,10 @@ class ReplicaRouter:
                 continue
             rid = next(self._rid)
             self._placed[rid] = (i, erid)
+            self._uids[rid] = uid
+            self._rlog.event(uid, "placed", router=self._router_id,
+                             replica=str(i), route=route,
+                             warm_tokens=int(warm))
             if session is not None:
                 self._affinity.setdefault(session, i)
             self._m_requests.labels(router=self._router_id,
@@ -201,6 +218,11 @@ class ReplicaRouter:
             return rid
         raise last_err if last_err is not None else RuntimeError(
             "no replica accepted the request")
+
+    def request_uid(self, rid: int) -> int:
+        """The lifecycle uid behind router request ``rid`` — one key
+        into the request log across every replica the request touched."""
+        return self._uids[rid]
 
     # -- scheduling --------------------------------------------------------
 

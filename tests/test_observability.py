@@ -143,6 +143,73 @@ def test_prometheus_text_exposition():
     assert "paddle_tpu_serving_ttft_ms_count 1" in lines
 
 
+def test_prometheus_text_escapes_nasty_values():
+    """Exposition-format compliance: backslash, double-quote and
+    newline in label values (and HELP text) must be escaped, and the
+    escaped line must round-trip back to the original value under the
+    format's unescaping rules — a raw newline would terminate the
+    sample line mid-value and corrupt the whole scrape."""
+    nasty = 'a\\b"c\nd'
+    reg = MetricsRegistry()
+    reg.counter("t.nasty", "help with\nnewline and \\ backslash").labels(
+        tenant=nasty).inc()
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    sample = [l for l in lines if l.startswith("paddle_tpu_t_nasty")]
+    assert sample == \
+        ['paddle_tpu_t_nasty_total{tenant="a\\\\b\\"c\\nd"} 1']
+    assert ("# HELP paddle_tpu_t_nasty_total help with\\nnewline "
+            "and \\\\ backslash") in lines
+    # round-trip: unescape per the exposition spec recovers the value
+    raw = sample[0].split('tenant="', 1)[1].rsplit('"}', 1)[0]
+    out, i = [], 0
+    while i < len(raw):
+        if raw[i] == "\\":
+            out.append({"\\": "\\", "n": "\n", '"': '"'}[raw[i + 1]])
+            i += 2
+        else:
+            out.append(raw[i])
+            i += 1
+    assert "".join(out) == nasty
+
+
+def test_snapshot_schema_version_and_byte_stable():
+    """snapshot() leads with schema_version and orders families/series
+    deterministically (the static_analysis --json convention): two
+    snapshots of the same state serialize byte-identically."""
+    reg = MetricsRegistry()
+    # register in non-sorted order with multi-label series
+    reg.counter("t.zz").labels(b="2", a="1").inc()
+    reg.counter("t.aa").labels(x="9").inc(2)
+    reg.histogram("t.mm", buckets=(1.0, 2.0)).observe(1.5)
+    snap = reg.snapshot()
+    assert snap["schema_version"] == obs.SNAPSHOT_SCHEMA_VERSION
+    assert list(snap)[0] == "schema_version"
+    assert list(snap)[1:] == ["t.aa", "t.mm", "t.zz"]
+    assert json.dumps(reg.snapshot()) == json.dumps(reg.snapshot())
+
+
+def test_trace_dropped_events_gauge_in_snapshot():
+    """SpanTracer ring drops surface as the obs.trace_dropped_events
+    gauge (not just export_chrome_trace metadata), so a wrapped ring
+    can't masquerade as a complete timeline in snapshot()."""
+    tr = obs.get_tracer()
+    old = tr.max_events
+    tr.max_events = 2
+    try:
+        for i in range(5):
+            tr.instant(f"drop{i}")
+        snap = obs.snapshot()
+        series = snap["obs.trace_dropped_events"]["series"]
+        assert series[0]["value"] == tr.dropped == 3
+    finally:
+        tr.max_events = old
+    # reset re-registers the gauge at 0: present in EVERY snapshot
+    obs.reset()
+    snap = obs.snapshot()
+    assert snap["obs.trace_dropped_events"]["series"][0]["value"] == 0
+
+
 # -- tracer ------------------------------------------------------------------
 
 def test_spans_nest_and_export_chrome_trace(tmp_path):
