@@ -164,6 +164,23 @@ DEFINE("kv_cache_num_blocks", 0,
 DEFINE("serving_prefix_cache", True,
        "register full prompt blocks in the paged cache's prefix trie and "
        "serve later prompts that share them without recompute")
+# quantized KV cache (serving/kv_cache.py + models/llama.py + the
+# flash-decode kernel): int8 blocks with per-block-per-kv-head scales
+# halve both resident-session HBM and the per-step cache stream — the
+# b=8 dead-tail regression growth_check_b8 flags
+DEFINE("serving_kv_cache_dtype", "bf16",
+       "KV-cache element dtype for the serving engine: 'bf16' (the "
+       "model dtype), 'int8' (per-block-per-kv-head symmetric scales, "
+       "quantized at scatter time, dequantized inside the flash-decode "
+       "chunk loop), or 'mixed' (paged only: blocks are written bf16 "
+       "and demoted to simulated-int8 when they become cold full "
+       "prefix blocks at registration).  Engine constructor arg "
+       "overrides")
+DEFINE("serving_int8_weights", False,
+       "wrap the serving engine's model with weight-only int8 "
+       "quantization (models/quantized.py) so decode matmuls take the "
+       "int8 Pallas path — combine with serving_kv_cache_dtype='int8' "
+       "for the full int8 serving configuration")
 # chunked prefill (serving/engine.py mixed steps): Sarathi-style
 # iteration-level token budgeting — prompts stream into the decode step
 # as fixed-size chunks instead of stalling it with whole-prompt waves

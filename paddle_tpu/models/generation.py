@@ -34,11 +34,28 @@ import jax.numpy as jnp
 from ..nn.layer import Layer as _Layer
 
 
-def init_kv_cache(config, batch_size: int, max_length: int, dtype=None):
-    """Pre-allocated cache: (L, 2, B, max_len, kv_heads, head_dim)."""
+def init_kv_cache(config, batch_size: int, max_length: int, dtype=None,
+                  quantized: bool = False):
+    """Pre-allocated cache: (L, 2, B, max_len, kv_heads, head_dim).
+
+    ``quantized=True`` returns the int8 contiguous cache instead — a
+    two-leaf pytree ``{"kv": int8 payload (same shape), "scale": f32
+    (L, 2, B, n_gran, kv_heads)}`` with one symmetric absmax scale per
+    128-token granule per kv head (one granule spanning the whole row
+    when ``max_length`` is not a multiple of 128, keeping tiny test
+    shapes usable; the Pallas dequant path needs the 128 alignment, the
+    reference path does not).  Same decode_step signature: llama's
+    ``LlamaAttention.decode`` detects the dict and quantizes at scatter
+    time."""
     dt = dtype if dtype is not None else config.dtype
-    return jnp.zeros((config.num_hidden_layers, 2, batch_size, max_length,
-                      config.num_key_value_heads, config.head_dim), dt)
+    shape = (config.num_hidden_layers, 2, batch_size, max_length,
+             config.num_key_value_heads, config.head_dim)
+    if not quantized:
+        return jnp.zeros(shape, dt)
+    n_gran = max_length // 128 if max_length % 128 == 0 else 1
+    return {"kv": jnp.zeros(shape, jnp.int8),
+            "scale": jnp.zeros((shape[0], 2, batch_size, n_gran,
+                                config.num_key_value_heads), jnp.float32)}
 
 
 # canonical home is the ops layer (models depend on ops, never the
@@ -143,7 +160,8 @@ def accept_draft_tokens(logits, drafts, draft_mask, key, temperature=0.0,
     return jnp.where(keep, out, jnp.int32(pad_token_id)), n
 
 
-def decode_mesh_specs(model, params, axis_names, paged_cache=False):
+def decode_mesh_specs(model, params, axis_names, paged_cache=False,
+                      quantized_cache=False):
     """The DECLARED mesh layout of the decode state, as PartitionSpecs
     filtered to ``axis_names`` (no devices touched):
 
@@ -195,8 +213,15 @@ def decode_mesh_specs(model, params, axis_names, paged_cache=False):
     batch = tuple(a for a in ("dp", "sharding") if a in names)
     if paged_cache:
         cache_spec = fs(None, None, None, None, "mp", None)
+        scale_spec = fs(None, None, None, "mp")
     else:
         cache_spec = fs(None, None, batch, None, "mp", None)
+        scale_spec = fs(None, None, batch, None, "mp")
+    if quantized_cache:
+        # int8 cache pytree: payload keeps the bf16 layout, the per-
+        # block(-granule)-per-kv-head scales shard their head axis on mp
+        # alongside it
+        cache_spec = {"kv": cache_spec, "scale": scale_spec}
     return param_specs, cache_spec, fs(batch)
 
 
@@ -222,14 +247,18 @@ def _place_on_mesh(model, params, cache, input_ids, paged_cache=False,
         return params, cache, input_ids
     from jax.sharding import NamedSharding
 
+    quantized = isinstance(cache, dict) and "kv" in cache
     param_specs, cache_spec, ids_spec = decode_mesh_specs(
-        model, params, mesh.axis_names, paged_cache=paged_cache)
+        model, params, mesh.axis_names, paged_cache=paged_cache,
+        quantized_cache=quantized)
     params = jax.tree_util.tree_map(
         lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
         params, param_specs)
     input_ids = jax.device_put(input_ids, NamedSharding(mesh, ids_spec))
-    if isinstance(cache, jax.Array) and cache.ndim == 6:
-        cache = jax.device_put(cache, NamedSharding(mesh, cache_spec))
+    if quantized or (isinstance(cache, jax.Array) and cache.ndim == 6):
+        cache = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+            cache, cache_spec)
     return params, cache, input_ids
 
 

@@ -23,6 +23,7 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Optional, Tuple
 
@@ -109,6 +110,85 @@ def tiny_llama_config(**overrides) -> LlamaConfig:
 def _batch_spec(ndim: int) -> Tuple:
     """Activation sharding: batch over (dp, sharding), seq over sep."""
     return (("dp", "sharding"), "sep") + (None,) * (ndim - 2)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _quantized_paged_write(kv, sc, idx: int, kvsl: int, x, phys, off):
+    """Scatter-time int8 quantization into the paged pool — the write
+    half of the quantized KV cache (the read half is the flash-decode
+    kernel's in-chunk dequant).
+
+    ``kv``: (L, 2, nb, bl, Hkv, D) int8 pool; ``sc``: (L, 2, nb, Hkv)
+    f32 per-block-per-kv-head scales; ``x``: (B, s, Hkv, D) new K or V;
+    ``phys``/``off``: (B, s) physical block / in-block offset per token.
+
+    Per-block scales are RUNNING maxima, so a new token whose absmax
+    exceeds its block's current scale grows the scale — and the block's
+    existing int8 payload must be re-expressed under the new scale or
+    its values would silently inflate.  Two-phase scatter, both phases
+    order-independent under duplicate indices:
+
+      1. block phase — scatter-max the per-token needed scales into the
+         scale rows, then rewrite each touched block's payload by
+         ``round(payload · old/new)``; tokens sharing a block gather the
+         SAME (old, new) pair, so duplicate block writes carry identical
+         payloads;
+      2. token phase — quantize each new token under its block's final
+         scale and scatter at its unique (phys, off) cell.
+
+    Pad tokens ride in with ``phys == 0`` (the null block): its scale
+    and payload become junk, which the null-block convention already
+    guarantees no reader trusts.  A zero final scale (empty block, zero
+    token) quantizes through a guard divisor of 1.
+    """
+    f32 = jnp.float32
+    needed = jnp.max(jnp.abs(x.astype(f32)), axis=-1) / 127.0  # (B,s,Hkv)
+    old = sc[idx, kvsl][phys]                                  # (B,s,Hkv)
+    sc = sc.at[idx, kvsl, phys].max(needed)
+    new = sc[idx, kvsl][phys]
+    safe = jnp.where(new > 0, new, 1.0)
+    ratio = jnp.where(new > 0, old / safe, 0.0)
+    pay = kv[idx, kvsl][phys]                            # (B,s,bl,Hkv,D)
+    pay = jnp.clip(jnp.round(pay.astype(f32)
+                             * ratio[:, :, None, :, None]), -127, 127)
+    kv = kv.at[idx, kvsl, phys].set(pay.astype(jnp.int8))
+    tok = jnp.clip(jnp.round(x.astype(f32) / safe[..., None]), -127, 127)
+    kv = kv.at[idx, kvsl, phys, off].set(tok.astype(jnp.int8))
+    return kv, sc
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _quantized_contiguous_write(kv, sc, idx: int, kvsl: int, x,
+                                position_ids):
+    """The contiguous-row form of :func:`_quantized_paged_write`: the
+    scale granule (``max_len // n_gran`` positions of one row) plays the
+    block's role.  ``kv``: (L, 2, B, max_len, Hkv, D) int8; ``sc``:
+    (L, 2, B, n_gran, Hkv) f32; ``position_ids``: (B, s) or (1, s) —
+    positions at/past ``max_len`` fall out of bounds and every scatter
+    drops them (the chunked engine's idle-row convention)."""
+    f32 = jnp.float32
+    b = kv.shape[2]
+    s = position_ids.shape[-1]
+    n_gran = sc.shape[3]
+    gr = kv.shape[3] // n_gran
+    pos = jnp.broadcast_to(position_ids, (b, s))
+    gi = pos // gr                                             # (B, s)
+    rr = jnp.arange(b)[:, None]
+    needed = jnp.max(jnp.abs(x.astype(f32)), axis=-1) / 127.0
+    old = sc[idx, kvsl][rr, gi]
+    sc = sc.at[idx, kvsl, rr, gi].max(needed)
+    new = sc[idx, kvsl][rr, gi]
+    safe = jnp.where(new > 0, new, 1.0)
+    ratio = jnp.where(new > 0, old / safe, 0.0)
+    pos_g = gi[..., None] * gr + jnp.arange(gr)                # (B,s,gr)
+    rr3 = rr[..., None]
+    pay = kv[idx, kvsl][rr3, pos_g]                      # (B,s,gr,Hkv,D)
+    pay = jnp.clip(jnp.round(pay.astype(f32)
+                             * ratio[:, :, None, :, None]), -127, 127)
+    kv = kv.at[idx, kvsl, rr3, pos_g].set(pay.astype(jnp.int8))
+    tok = jnp.clip(jnp.round(x.astype(f32) / safe[..., None]), -127, 127)
+    kv = kv.at[idx, kvsl, rr, pos].set(tok.astype(jnp.int8))
+    return kv, sc
 
 
 class LlamaAttention(Layer):
@@ -238,6 +318,8 @@ class LlamaAttention(Layer):
         from ..ops.attention import cached_decode_attention
 
         b, s, _ = x.shape
+        quantized = isinstance(cache, dict)
+        kvp = cache["kv"] if quantized else cache
         paged = block_tables is not None
         per_row = getattr(pos, "ndim", 0) == 1
         if paged and not per_row:
@@ -255,7 +337,7 @@ class LlamaAttention(Layer):
             rope_ids = position_ids
         q, k, v = self._qkv(x, rope_cache, rope_ids)
         if paged:
-            bl = cache.shape[3]
+            bl = kvp.shape[3]
             max_blocks = block_tables.shape[1]
             rows = jnp.arange(b)[:, None]                          # (B, 1)
             lb = position_ids // bl                                # (B, s)
@@ -264,12 +346,48 @@ class LlamaAttention(Layer):
                 block_tables[rows, jnp.minimum(lb, max_blocks - 1)],
                 jnp.int32(0))              # out-of-table pads -> null block
             off = position_ids % bl
+            q = constrain(q, ("dp", "sharding"), None, "mp", None)
+            if quantized:
+                sc = cache["scale"]
+                kvp, sc = _quantized_paged_write(kvp, sc, idx, 0, k,
+                                                 phys, off)
+                kvp, sc = _quantized_paged_write(kvp, sc, idx, 1, v,
+                                                 phys, off)
+                kvp = constrain(kvp, None, None, None, None, "mp", None)
+                sc = constrain(sc, None, None, None, "mp")
+                cache = {"kv": kvp, "scale": sc}
+                out = cached_decode_attention(
+                    q, kvp[idx, 0], kvp[idx, 1], pos,
+                    block_tables=block_tables,
+                    k_scale=sc[idx, 0], v_scale=sc[idx, 1])
+                return matmul(out.reshape(b, s, -1), self.o_proj), cache
             cache = cache.at[idx, 0, phys, off].set(k.astype(cache.dtype))
             cache = cache.at[idx, 1, phys, off].set(v.astype(cache.dtype))
-            q = constrain(q, ("dp", "sharding"), None, "mp", None)
             cache = constrain(cache, None, None, None, None, "mp", None)
             out = cached_decode_attention(q, cache[idx, 0], cache[idx, 1],
                                           pos, block_tables=block_tables)
+            return matmul(out.reshape(b, s, -1), self.o_proj), cache
+        if quantized:
+            sc = cache["scale"]
+            kvp, sc = _quantized_contiguous_write(kvp, sc, idx, 0, k,
+                                                  position_ids)
+            kvp, sc = _quantized_contiguous_write(kvp, sc, idx, 1, v,
+                                                  position_ids)
+            q = constrain(q, ("dp", "sharding"), None, "mp", None)
+            kvp = constrain(kvp, None, None, ("dp", "sharding"), None,
+                            "mp", None)
+            sc = constrain(sc, None, None, ("dp", "sharding"), None, "mp")
+            cache = {"kv": kvp, "scale": sc}
+            if isinstance(pos, int) and pos == 0 and s > 1:
+                # prefill keeps the exact fresh K/V for the flash read;
+                # the quantization loss starts at the first cached read
+                k = constrain(k, ("dp", "sharding"), None, "mp", None)
+                v = constrain(v, ("dp", "sharding"), None, "mp", None)
+                out = flash_attention(q, k, v, causal=True)
+            else:
+                out = cached_decode_attention(
+                    q, kvp[idx, 0], kvp[idx, 1], pos,
+                    k_scale=sc[idx, 0], v_scale=sc[idx, 1])
             return matmul(out.reshape(b, s, -1), self.o_proj), cache
         if per_row:
             rows = jnp.arange(b)[:, None]                          # (B, 1)

@@ -115,7 +115,8 @@ def flash_attention_reference(q, k, v, attn_mask=None, dropout_p: float = 0.0,
 
 def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
                           kv_len: int, has_extra_mask: bool = False,
-                          paged_block_len: Optional[int] = None):
+                          paged_block_len: Optional[int] = None,
+                          quantized: bool = False):
     """The flash-decode dispatch decision for one shape, exposed so
     bench.py can record the chosen path per row: returns
     ``("pallas_decode", None)`` or ``("xla_math", reason)``.
@@ -144,9 +145,13 @@ def decode_attention_path(b: int, s: int, hq: int, hkv: int, d: int,
     # a kernel_path_hint (ops/_dispatch.py) relabels the decision — the
     # serving engine's speculative verify step counts as op="spec_verify"
     # so a draft window silently sliding off its path is its own series
+    # a quantized pool relabels the cache axis: ops.kernel_path
+    # {op="decode_attention", cache="int8"} is the int8 serving path's
+    # own routing series (the satellite observability contract)
     _dispatch.count_kernel_path(
         _dispatch.kernel_path_op("decode_attention"), path,
-        cache="paged" if paged_block_len is not None else "contiguous")
+        cache="int8" if quantized else
+        ("paged" if paged_block_len is not None else "contiguous"))
     return path, reason
 
 
@@ -217,7 +222,8 @@ def _decode_attention_decision(b, s, hq, hkv, d, kv_len, has_extra_mask,
 def cached_decode_attention(q, k_cache, v_cache, pos,
                             scale: Optional[float] = None,
                             extra_mask=None, live_len: Optional[int] = None,
-                            block_tables=None):
+                            block_tables=None,
+                            k_scale=None, v_scale=None):
     """Incremental decode attention over a pre-allocated cache — the
     serving hot path (parity: the reference's masked_multihead_attention /
     fused decode-attention core, upstream
@@ -247,24 +253,33 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
     lives in physical block ``block_tables[i, j]``.  The Pallas kernel
     dereferences the table in its scalar-prefetch index maps; the XLA
     fallback gathers the table into the contiguous layout first.
+
+    ``k_scale``/``v_scale``: f32 per-block-per-kv-head dequant scales for
+    an int8 cache (paged: ``(num_blocks, Hkv)``; contiguous:
+    ``(B, n_granules, Hkv)``) — the Pallas kernel dequantizes inside its
+    KV-chunk loop; the XLA fallback dequantizes after its gather.
     """
     b, s, hq, d = q.shape
+    quantized = k_scale is not None
     if block_tables is not None:
         _, block_len, hkv, _ = k_cache.shape
         kv_len = block_tables.shape[1] * block_len
         path, reason = decode_attention_path(b, s, hq, hkv, d, kv_len,
                                              extra_mask is not None,
-                                             paged_block_len=block_len)
+                                             paged_block_len=block_len,
+                                             quantized=quantized)
     else:
         _, kv_len, hkv, _ = k_cache.shape
         path, reason = decode_attention_path(b, s, hq, hkv, d, kv_len,
-                                             extra_mask is not None)
+                                             extra_mask is not None,
+                                             quantized=quantized)
     if path == "pallas_decode":
         try:
             from .pallas.decode_attention import decode_attention_pallas
             return decode_attention_pallas(
                 q, k_cache, v_cache, pos, scale=scale, live_len=live_len,
                 block_tables=block_tables,
+                k_scale=k_scale, v_scale=v_scale,
                 interpret=_dispatch.pallas_interpret())
         except NotImplementedError as e:
             reason = str(e)
@@ -280,14 +295,38 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
                                              scale=scale,
                                              extra_mask=extra_mask,
                                              live_len=live_len,
-                                             block_tables=block_tables)
+                                             block_tables=block_tables,
+                                             k_scale=k_scale,
+                                             v_scale=v_scale)
+
+
+@jax.jit
+def _dequant_decode_attention(k_cache, v_cache, k_scale, v_scale):
+    """Widen an int8 K/V view back to f32 under its per-block-per-kv-head
+    scales — the XLA fallback's dequant, numerically the oracle for the
+    kernel's in-chunk dequant.
+
+    A NAMED jitted helper on purpose: the int8→f32 convert of a
+    cache-sized tensor is exactly the widening the ``dtype-promotion``
+    graph-lint rule exists to flag, so it must happen under a path
+    component (``pjit[_dequant_decode_attention]``) the rule's
+    decode-attention-scoped int8 allowlist can recognise; an unintended
+    widening elsewhere in a quantized layout still fails the lint.
+    """
+    # scales are per (block, kv_head): k_cache here is the per-row
+    # (B, n_blocks, bl, Hkv, D) gathered view and the scale row
+    # broadcasts over the block's token axis
+    k = k_cache.astype(jnp.float32) * k_scale[..., None, :, None]
+    v = v_cache.astype(jnp.float32) * v_scale[..., None, :, None]
+    return k, v
 
 
 def cached_decode_attention_reference(q, k_cache, v_cache, pos,
                                       scale: Optional[float] = None,
                                       extra_mask=None,
                                       live_len: Optional[int] = None,
-                                      block_tables=None):
+                                      block_tables=None,
+                                      k_scale=None, v_scale=None):
     """The XLA math path of :func:`cached_decode_attention` (and its
     numerical oracle): masked softmax over the whole cache read.
 
@@ -324,10 +363,32 @@ def cached_decode_attention_reference(q, k_cache, v_cache, pos,
             mb = -(-int(live_len) // bl)
             block_tables = block_tables[:, :mb]
         # (B, mb) pool gather -> (B, mb, bl, Hkv, D) -> contiguous view
-        k_cache = jnp.take(k_cache, block_tables, axis=0,
-                           mode="clip").reshape(b, mb * bl, hkv_p, d)
-        v_cache = jnp.take(v_cache, block_tables, axis=0,
-                           mode="clip").reshape(b, mb * bl, hkv_p, d)
+        k_cache = jnp.take(k_cache, block_tables, axis=0, mode="clip")
+        v_cache = jnp.take(v_cache, block_tables, axis=0, mode="clip")
+        if k_scale is not None:
+            # int8 pool: gather the same blocks' scale rows and widen
+            # (the named helper keeps the widening lint-allowlistable)
+            k_cache, v_cache = _dequant_decode_attention(
+                k_cache, v_cache,
+                jnp.take(jnp.asarray(k_scale, jnp.float32), block_tables,
+                         axis=0, mode="clip"),
+                jnp.take(jnp.asarray(v_scale, jnp.float32), block_tables,
+                         axis=0, mode="clip"))
+        k_cache = k_cache.reshape(b, mb * bl, hkv_p, d)
+        v_cache = v_cache.reshape(b, mb * bl, hkv_p, d)
+    elif k_scale is not None:
+        # contiguous int8 rows: view each row as its scale granules,
+        # widen under the per-granule-per-head scales, view back
+        _, L0, hkv_c, _ = k_cache.shape
+        n_gran = k_scale.shape[1]
+        gr = L0 // n_gran
+        k_cache, v_cache = _dequant_decode_attention(
+            k_cache.reshape(b, n_gran, gr, hkv_c, d),
+            v_cache.reshape(b, n_gran, gr, hkv_c, d),
+            jnp.asarray(k_scale, jnp.float32),
+            jnp.asarray(v_scale, jnp.float32))
+        k_cache = k_cache.reshape(b, L0, hkv_c, d)
+        v_cache = v_cache.reshape(b, L0, hkv_c, d)
     if live_len is not None and live_len < k_cache.shape[1]:
         k_cache = k_cache[:, :live_len]
         v_cache = v_cache[:, :live_len]

@@ -112,8 +112,15 @@ def _pick_block_kv(kv_len: int, cap: int) -> int:
     return 0
 
 
-def _kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
-            l_sc, *, scale, s, g, hkv, d, bq, tile_p, bk, chunks):
+def _kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, *refs, scale, s, g,
+            hkv, d, bq, tile_p, bk, chunks, quantized):
+    if quantized:
+        # int8 cache: the per-block-per-kv-head scales ride as two more
+        # block-table-indexed operands (same index map, same dead-tail
+        # clamp, same DMA elision) — one (1, hkv) f32 row per KV chunk
+        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = refs
+    else:
+        o_ref, acc_sc, m_sc, l_sc = refs
     del bt_ref  # consumed by the index maps, not the body
     bi = pl.program_id(0)
     qi = pl.program_id(1)
@@ -145,9 +152,21 @@ def _kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
             qh = q_ref[0, h]                   # (tile_p, d)
             kh = kv[:, h * d:(h + 1) * d]      # static lane slice
             vh = vv[:, h * d:(h + 1) * d]
+            if quantized:
+                # int8 in [-127, 127] is exact in bf16, so the cast is
+                # lossless; the block's uniform scale folds into the
+                # existing post-dot scalar multiplies (K into the
+                # softmax scale, V after the PV accumulate) — no
+                # per-element dequant multiply on the chunk
+                kh = kh.astype(qh.dtype)
+                vh = vh.astype(qh.dtype)
+                k_s = scale * ks_ref[0, h]
+                v_s = vs_ref[0, h]
+            else:
+                k_s = scale
             sc = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # (tile_p, bk)
+                preferred_element_type=jnp.float32) * k_s  # (tile_p, bk)
             sc = jnp.where(keep, sc, NEG_INF)
             m_prev = m_sc[h][:, :1]
             m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
@@ -159,6 +178,8 @@ def _kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
             pv = jax.lax.dot_general(
                 p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            if quantized:
+                pv = pv * v_s
             acc_sc[h] = acc_sc[h] * alpha + pv
             m_sc[h] = jnp.broadcast_to(m_new, m_sc[h].shape)
             l_sc[h] = jnp.broadcast_to(l_new, l_sc[h].shape)
@@ -176,7 +197,8 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
                             block_kv: int = 0,
                             live_len: Optional[int] = None,
                             interpret: bool = False,
-                            block_tables=None):
+                            block_tables=None,
+                            k_scale=None, v_scale=None):
     """Flash-decode over a pre-allocated cache → (B, s, Hq, D) in q.dtype.
 
     q: (B, s, Hq, D) new-token queries (s = 1 in steady-state decode,
@@ -199,8 +221,21 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
     streaming at each row's live prefix dynamically).  Raises
     NotImplementedError for shapes the kernel does not cover (callers
     fall back to the XLA math path).
+
+    **int8 cache** (``k_scale``/``v_scale`` given): k_cache/v_cache hold
+    int8 payloads and the f32 scales carry the per-block-per-kv-head
+    dequant factor — paged: ``(num_blocks, Hkv)`` rows of the same pool
+    the block table indexes; contiguous: ``(B, n_granules, Hkv)`` where
+    the KV chunk is pinned to the scale granule
+    (``kv_len // n_granules``, 128-aligned).  Dequant happens inside the
+    chunk loop by folding each block's scale into the post-dot scalar
+    multiplies, so the HBM stream is the int8 payload — half the bf16
+    bytes.
     """
     b, s, hq, d = q.shape
+    quantized = k_scale is not None
+    if quantized and v_scale is None:
+        raise ValueError("int8 cache needs both k_scale and v_scale")
     if block_tables is not None:
         n_pool, bk, hkv, _ = k_cache.shape
         if bk % 128:
@@ -211,6 +246,9 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         # pool layout: one physical block == one KV chunk == one DMA
         k2 = k_cache.reshape(n_pool, bk, hkv * d)
         v2 = v_cache.reshape(n_pool, bk, hkv * d)
+        if quantized:
+            ks2 = jnp.asarray(k_scale, jnp.float32).reshape(n_pool, hkv)
+            vs2 = jnp.asarray(v_scale, jnp.float32).reshape(n_pool, hkv)
     else:
         _, kv_len, hkv, _ = k_cache.shape
     if hq % hkv or hkv == 0:
@@ -238,14 +276,24 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
     if scale is None:
         scale = d ** -0.5
     if block_tables is None:
-        if not block_kv:
-            from ...flags import flag
-            block_kv = int(flag("decode_attention_block_kv"))
-        bk = _pick_block_kv(kv_len, block_kv)
-        if not bk:
-            raise NotImplementedError(
-                f"max_length {kv_len} has no 128-aligned chunk divisor "
-                f"<= {block_kv}")
+        if quantized:
+            # the scale granule pins the KV chunk: one chunk == one
+            # (block, head) scale entry, exactly the paged contract
+            n_gran = k_scale.shape[1]
+            bk = kv_len // n_gran
+            if bk * n_gran != kv_len or bk % 128:
+                raise NotImplementedError(
+                    f"int8 scale granule {kv_len}/{n_gran} is not a "
+                    f"128-aligned divisor of the cache length")
+        else:
+            if not block_kv:
+                from ...flags import flag
+                block_kv = int(flag("decode_attention_block_kv"))
+            bk = _pick_block_kv(kv_len, block_kv)
+            if not bk:
+                raise NotImplementedError(
+                    f"max_length {kv_len} has no 128-aligned chunk "
+                    f"divisor <= {block_kv}")
         # contiguous = paged under the identity table: view the cache as a
         # (B·chunks, bk, Hkv·D) pool (free reshape) with table
         # [bi, ki] = bi·chunks + ki — same DMAs, one code path
@@ -254,6 +302,11 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
               + jnp.arange(full, dtype=jnp.int32)[None, :])
         k2 = k_cache.reshape(b * full, bk, hkv * d)
         v2 = v_cache.reshape(b * full, bk, hkv * d)
+        if quantized:
+            ks2 = jnp.asarray(k_scale, jnp.float32).reshape(
+                b * full, hkv)
+            vs2 = jnp.asarray(v_scale, jnp.float32).reshape(
+                b * full, hkv)
     chunks = kv_len // bk
     if live_len is not None:
         chunks = max(1, min(chunks, -(-int(live_len) // bk)))
@@ -286,11 +339,12 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
     _disp.count_kernel_path(
         _disp.kernel_path_op(
             "chunked_prefill" if nq > 1 else "decode_attention_kernel"),
-        "paged" if block_tables is not None else "contiguous")
+        "paged" if block_tables is not None else "contiguous",
+        **({"cache": "int8"} if quantized else {}))
 
     kernel = functools.partial(
         _kernel, scale=float(scale), s=s, g=g, hkv=hkv, d=d, bq=bq,
-        tile_p=tile_p, bk=bk, chunks=chunks)
+        tile_p=tile_p, bk=bk, chunks=chunks, quantized=quantized)
 
     def q_idx(bi, qi, ki, pos_ref, bt_ref):
         return (bi, 0, qi, 0)
@@ -303,16 +357,29 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         last = (pos_ref[bi] + jnp.minimum((qi + 1) * bq, s) - 1) // bk
         return (bt_ref[bi, jnp.minimum(ki, last)], 0, 0)
 
+    def sc_idx(bi, qi, ki, pos_ref, bt_ref):
+        # the scale rows ride the same table dereference (and the same
+        # dead-tail clamp) as the KV chunks they dequantize
+        last = (pos_ref[bi] + jnp.minimum((qi + 1) * bq, s) - 1) // bk
+        return (bt_ref[bi, jnp.minimum(ki, last)], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, tile_p, d), q_idx),
+        pl.BlockSpec((1, bk, hkv * d), kv_idx),
+        pl.BlockSpec((1, bk, hkv * d), kv_idx),
+    ]
+    operands = (pos_arr, bt, qg, k2, v2)
+    if quantized:
+        in_specs += [pl.BlockSpec((1, hkv), sc_idx),
+                     pl.BlockSpec((1, hkv), sc_idx)]
+        operands += (ks2, vs2)
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, nq, chunks),
-            in_specs=[
-                pl.BlockSpec((1, hkv, tile_p, d), q_idx),
-                pl.BlockSpec((1, bk, hkv * d), kv_idx),
-                pl.BlockSpec((1, bk, hkv * d), kv_idx),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, hkv, tile_p, d), q_idx),
             scratch_shapes=[
                 pltpu.VMEM((hkv, tile_p, d), jnp.float32),
@@ -324,7 +391,7 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(pos_arr, bt, qg, k2, v2)
+    )(*operands)
     out = out.reshape(b, hkv, nq, tile_p, d)[:, :, :, :bq * g]
     out = out.reshape(b, hkv, nq * bq * g, d)[:, :, :rows]
     out = out.reshape(b, hkv, s, g, d).transpose(0, 2, 1, 3, 4)

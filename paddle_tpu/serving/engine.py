@@ -170,6 +170,20 @@ _ENGINE_IDS = itertools.count()
 _PREFILL_TRACE_BUDGET = 16
 
 
+def _slot_row(cache, cslot):
+    """One slot's row of the contiguous cache — batch is axis 2 in every
+    leaf, for the plain array and the int8 {kv, scale} pytree alike."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, cslot, 1, axis=2), cache)
+
+
+def _slot_row_update(cache, row, cslot):
+    z = jnp.int32(0)
+    return jax.tree_util.tree_map(
+        lambda a, r: jax.lax.dynamic_update_slice(
+            a, r, (z, z, cslot) + (z,) * (a.ndim - 3)), cache, row)
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs.  These become traced (num_slots,)
@@ -251,6 +265,8 @@ class ServingEngine:
                  chunk_policy: Optional[str] = None,
                  spec_decode: Optional[bool] = None,
                  spec_k: Optional[int] = None,
+                 kv_cache_dtype: Optional[str] = None,
+                 int8_weights: Optional[bool] = None,
                  mesh=None):
         """``paged`` (default FLAGS_serving_paged_kv) selects the paged
         block-pool cache; ``block_len`` (FLAGS_kv_cache_block_len) and
@@ -292,7 +308,21 @@ class ServingEngine:
         The Pallas decode kernel is gated off under a mesh (the XLA
         gather path partitions under GSPMD; see
         ``ops.attention._mesh_sharded_trace``); greedy outputs stay
-        token-identical to the single-chip engine in every layout."""
+        token-identical to the single-chip engine in every layout.
+
+        ``kv_cache_dtype`` (default FLAGS_serving_kv_cache_dtype):
+        ``'bf16'`` keeps the model-dtype cache; ``'int8'`` stores K/V as
+        int8 with per-block(-granule)-per-kv-head symmetric scales —
+        quantized at scatter time inside the step, dequantized inside
+        the flash-decode chunk loop — halving the cache footprint and
+        the per-step streamed cache bytes; ``'mixed'`` (paged only)
+        writes blocks bf16 and demotes them to simulated int8 (an
+        in-place quantize→dequantize device rewrite) when they register
+        as cold full prefix blocks.  ``int8_weights`` (default
+        FLAGS_serving_int8_weights) wraps the model with
+        ``quantize_for_decode`` so the engine's linear layers run the
+        weight-only int8 path.  Both compose with every layout above;
+        every program stays jitted once."""
         if hasattr(model, "init_decode_state"):
             raise NotImplementedError(
                 "ServingEngine requires the stacked KV cache; recurrent "
@@ -302,6 +332,12 @@ class ServingEngine:
             raise ValueError(
                 f"max_length {max_length} exceeds the model's "
                 f"max_position_embeddings ({limit})")
+        self._int8_weights = bool(
+            _flags.flag("serving_int8_weights")
+            if int8_weights is None else int8_weights)
+        if self._int8_weights and not hasattr(model, "unwrapped"):
+            from ..models.quantized import quantize_for_decode
+            model = quantize_for_decode(model)
         self.model = model
         self.config = model.config
         self.num_slots = int(num_slots)
@@ -311,6 +347,21 @@ class ServingEngine:
         self.prefill_batch = int(prefill_batch)
         self.paged = bool(_flags.flag("serving_paged_kv")
                           if paged is None else paged)
+        self.kv_dtype = str(kv_cache_dtype
+                            or _flags.flag("serving_kv_cache_dtype"))
+        if self.kv_dtype not in ("bf16", "int8", "mixed"):
+            raise ValueError(
+                f"kv_cache_dtype must be bf16|int8|mixed, got "
+                f"{self.kv_dtype!r}")
+        if self.kv_dtype == "mixed" and not self.paged:
+            raise ValueError(
+                "kv_cache_dtype='mixed' requires the paged cache: "
+                "demotion is per-block, and contiguous rows have no "
+                "block registration point")
+        # 'int8' quantizes the DEVICE pool (dict cache, scales as step
+        # operands); 'mixed' keeps the device pool bf16 and simulates
+        # int8 per demoted block, so only 'int8' changes program shapes
+        self.quantized = self.kv_dtype == "int8"
         self.chunked = bool(_flags.flag("serving_chunked_prefill")
                             if chunked is None else chunked)
         self.prefill_chunk = int(prefill_chunk
@@ -354,32 +405,109 @@ class ServingEngine:
             self.kv = BlockManager(
                 nb, bl,
                 prefix_cache=bool(_flags.flag("serving_prefix_cache")
-                                  if prefix_cache is None else prefix_cache))
-            cache = init_paged_kv_cache(model.config, nb, bl)
+                                  if prefix_cache is None else prefix_cache),
+                kv_dtype=self.kv_dtype)
+            cache = init_paged_kv_cache(model.config, nb, bl,
+                                        quantized=self.quantized)
+            # arm the pool's bytes_by_dtype gauges with this model's
+            # per-block costs (payload + the int8 block's scale row)
+            c = model.config
+            tok = (c.num_hidden_layers * 2 * c.num_key_value_heads
+                   * c.head_dim)
+            native = jnp.zeros((), c.dtype).dtype.itemsize
+            self.kv.set_block_nbytes({
+                "bf16": tok * bl * native,
+                "int8": tok * bl
+                + c.num_hidden_layers * 2 * c.num_key_value_heads * 4})
             self._tables = np.zeros((self.num_slots, self.max_blocks),
                                     np.int32)
         else:
             cache = init_kv_cache(model.config, self.num_slots,
-                                  self.max_length)
+                                  self.max_length,
+                                  quantized=self.quantized)
         params, cache, _ = _place_on_mesh(
             self._bind, params, cache,
             jnp.zeros((self.num_slots, 1), jnp.int32),
             paged_cache=self.paged, mesh=self.mesh)
         self._params, self._cache = params, cache
+        self._pending_demote: List[int] = []
         if self.paged:
             # COW device copy (compiled once; only dispatched when a
             # shared block is about to be written — see kv_cache.py).
             # The pool is donated: the copy aliases it in place.  Under
             # a mesh the pool keeps its declared sharding through the
             # copy (the block axis is unsharded, so a block copy never
-            # crosses devices).
+            # crosses devices).  The int8 pool copies the block's scale
+            # row along with its payload — COW destinations inherit the
+            # source's live quantization scale.
+            if self.quantized:
+                def _cow_impl(c, src, dst):
+                    return {
+                        "kv": c["kv"].at[:, :, dst].set(c["kv"][:, :, src]),
+                        "scale": c["scale"].at[:, :, dst].set(
+                            c["scale"][:, :, src])}
+            else:
+                def _cow_impl(c, src, dst):
+                    return c.at[:, :, dst].set(c[:, :, src])
             self._cow_fn = _obs.track_retraces(
-                lambda c, src, dst: c.at[:, :, dst].set(c[:, :, src]),
+                _cow_impl,
                 "serving.cow", labels={"engine": self._eid},
                 donate_argnums=(0,),
                 **(self._mesh_jit_shardings(3, 1, cache_argnum=0,
                                             with_params=False)
                    if self.mesh is not None else {}))
+        if self.paged and self.quantized:
+            # a reused block carries its previous tenant's scale row; the
+            # running-max write path would inherit it and quantize the
+            # new tenant too coarsely, so every block newly appended to a
+            # chain (BlockManager.drain_fresh) gets its scale zeroed
+            # before the next dispatch.  Mask form: one static shape, one
+            # compile, and the scale tensor is tiny.
+            def _reset_impl(c, mask):
+                return {"kv": c["kv"],
+                        "scale": jnp.where(mask[None, None, :, None],
+                                           jnp.float32(0), c["scale"])}
+            self._scale_reset_fn = _obs.track_retraces(
+                _reset_impl, "serving.scale_reset",
+                labels={"engine": self._eid}, donate_argnums=(0,),
+                **(self._mesh_jit_shardings(2, 1, cache_argnum=0,
+                                            with_params=False)
+                   if self.mesh is not None else {}))
+        if not self.paged and self.quantized:
+            # contiguous slot reuse (chunked admission writes into a row
+            # a retired request used): zero the row's granule scales
+            def _row_reset_impl(c, slot):
+                return {"kv": c["kv"],
+                        "scale": c["scale"].at[:, :, slot].set(0.0)}
+            self._row_reset_fn = _obs.track_retraces(
+                _row_reset_impl, "serving.scale_reset",
+                labels={"engine": self._eid}, donate_argnums=(0,),
+                **(self._mesh_jit_shardings(2, 1, cache_argnum=0,
+                                            with_params=False)
+                   if self.mesh is not None else {}))
+        if self.paged and self.kv_dtype == "mixed":
+            # mixed mode: the pool stays bf16 (plain array, plain step
+            # programs) and a block demoted by the BlockManager — cold
+            # full prefix block at trie registration — is rewritten
+            # in place through a quantize→dequantize round trip
+            # (simulated int8: the precision of the quantized store, the
+            # layout of the hot path).  Applied AFTER the dispatch that
+            # writes the block's contents (registration precedes the
+            # wave-prefill dispatch), via the _pending_demote queue.
+            def _demote_impl(c, bid):
+                blk = c[:, :, bid].astype(jnp.float32)  # (L,2,bl,Hkv,D)
+                sc = jnp.max(jnp.abs(blk), axis=(2, 4),
+                             keepdims=True) / 127.0
+                safe = jnp.where(sc > 0, sc, 1.0)
+                q = jnp.clip(jnp.round(blk / safe), -127, 127)
+                return c.at[:, :, bid].set((q * safe).astype(c.dtype))
+            self._demote_fn = _obs.track_retraces(
+                _demote_impl, "serving.demote",
+                labels={"engine": self._eid}, donate_argnums=(0,),
+                **(self._mesh_jit_shardings(2, 1, cache_argnum=0,
+                                            with_params=False)
+                   if self.mesh is not None else {}))
+            self.kv.on_demote = self._pending_demote.extend
 
         # host-side mirrors of the step inputs (tiny; re-uploaded per tick)
         s = self.num_slots
@@ -529,20 +657,26 @@ class ServingEngine:
 
         param_specs, cache_spec, _ = decode_mesh_specs(
             self._bind, self._params, self.mesh.axis_names,
-            paged_cache=self.paged)
+            paged_cache=self.paged, quantized_cache=self.quantized)
 
         def ns(spec):
             return NamedSharding(self.mesh, spec)
 
+        def ns_cache(spec):
+            # int8 cache spec is a {kv, scale} pytree of PartitionSpecs
+            # (tuple subclasses — tree_map must not descend into them)
+            return jax.tree_util.tree_map(
+                ns, spec, is_leaf=lambda x: isinstance(x, P))
+
         repl = ns(P())
         in_sh = [repl] * n_args
-        in_sh[cache_argnum] = ns(cache_spec)
+        in_sh[cache_argnum] = ns_cache(cache_spec)
         if with_params:
             in_sh[0] = jax.tree_util.tree_map(ns, param_specs)
         if n_out == 1:
-            out_sh = ns(cache_spec)
+            out_sh = ns_cache(cache_spec)
         else:
-            out_sh = tuple([repl] * (n_out - 1) + [ns(cache_spec)])
+            out_sh = tuple([repl] * (n_out - 1) + [ns_cache(cache_spec)])
         return {"in_shardings": tuple(in_sh), "out_shardings": out_sh}
 
     def _init_metrics(self):
@@ -652,6 +786,18 @@ class ServingEngine:
             "tokens committed per active slot per verify step (1 = no "
             "speculative win that step; k+1 = whole window accepted)",
             buckets=(1, 2, 3, 4, 5, 6, 7, 8, 16)).labels(**lbl)
+        # int8 KV cache (quantization accounting conventions: BASELINE.md)
+        self._m_demoted = ctr(
+            "serving.kv_demoted_blocks",
+            "mixed-mode blocks rewritten to simulated int8 at trie "
+            "registration").labels(**lbl)
+        self._m_dequant_err = hist(
+            "serving.kv_dequant_error",
+            "max |logit(bf16) - logit(int8-KV)| observed by a parity "
+            "oracle (tests / bench feed this; the engine never computes "
+            "it on the hot path)",
+            buckets=(1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1,
+                     1.0)).labels(**lbl)
         self._m_step_traces = ctr(
             "jit.traces", "").labels(site="serving.step", **lbl)
         self._m_prefill_traces = ctr(
@@ -680,12 +826,18 @@ class ServingEngine:
         num_slots``; the ``mode="drop"`` scatter discards them.  One
         compilation per padded prompt-bucket length."""
         nb = ids.shape[0]
-        sub = init_kv_cache(self.config, nb, self.max_length)
+        sub = init_kv_cache(self.config, nb, self.max_length,
+                            quantized=self.quantized)
         with bind_params(self._bind, self._prepare(params)):
             logits, sub = self.model.decode_step(ids, sub, 0)
         last = logits[jnp.arange(nb), plens - 1]           # (nb, vocab)
         tok = sample_tokens(last, key, temps, topk, topp)
-        cache = cache.at[:, :, slot_ids].set(sub, mode="drop")
+        # leaf-wise slot scatter (the int8 cache is a {kv, scale} pytree
+        # with batch at axis 2 in both leaves; the fresh sub-cache's zero
+        # scales reset the reused rows' quantization state for free)
+        cache = jax.tree_util.tree_map(
+            lambda c, s: c.at[:, :, slot_ids].set(s, mode="drop"),
+            cache, sub)
         return tok, cache
 
     def _step_impl_paged(self, params, cache, tokens, positions, tables,
@@ -753,16 +905,14 @@ class ServingEngine:
                 tokens[:, None], cache, positions)
         nxt = sample_tokens(logits[:, -1], key, temps, topk, topp)
         nxt = jnp.where(slot_mask, nxt, jnp.int32(self.pad_token_id))
-        row = jax.lax.dynamic_slice_in_dim(cache, cslot, 1, axis=2)
+        row = _slot_row(cache, cslot)
         with bind_params(self._bind, prep):
             clogits, row = self.model.decode_step(
                 cids, row, cpos[None])          # (1,) per-row position
         ctok = sample_tokens(clogits[0, clen - 1][None],
                              jax.random.fold_in(key, 1),
                              ctemp, ctopk, ctopp)[0]
-        z = jnp.int32(0)
-        cache = jax.lax.dynamic_update_slice(cache, row,
-                                             (z, z, cslot, z, z, z))
+        cache = _slot_row_update(cache, row, cslot)
         return nxt, ctok, cache
 
     def _mixed_step_impl_paged(self, params, cache, tokens, positions,
@@ -859,15 +1009,13 @@ class ServingEngine:
             topp, key)
         out = jnp.where(slot_mask[:, None], out,
                         jnp.int32(self.pad_token_id))
-        row = jax.lax.dynamic_slice_in_dim(cache, cslot, 1, axis=2)
+        row = _slot_row(cache, cslot)
         with bind_params(self._bind, self._prepare(params)):
             clogits, row = self.model.decode_step(cids, row, cpos[None])
         ctok = sample_tokens(clogits[0, clen - 1][None],
                              jax.random.fold_in(key, 1),
                              ctemp, ctopk, ctopp)[0]
-        z = jnp.int32(0)
-        cache = jax.lax.dynamic_update_slice(cache, row,
-                                             (z, z, cslot, z, z, z))
+        cache = _slot_row_update(cache, row, cslot)
         return out, n_acc, ctok, cache
 
     def _spec_mixed_step_impl_paged(self, params, cache, tokens,
@@ -1008,6 +1156,37 @@ class ServingEngine:
         if changed:
             self._tables[i] = self.kv.table_row(i, self.max_blocks)
 
+    def _flush_fresh_scales(self):
+        """int8 pool pre-dispatch hygiene: zero the device scale rows of
+        every block newly appended to a chain since the last dispatch
+        (see BlockManager.drain_fresh) so a reused block's stale scale
+        never inflates its new tenant's quantization."""
+        if not (self.paged and self.quantized):
+            return
+        fresh = self.kv.drain_fresh()
+        if not fresh:
+            return
+        mask = np.zeros((self.kv.num_blocks,), bool)
+        mask[fresh] = True
+        self._cache = self._scale_reset_fn(self._cache, jnp.asarray(mask))
+
+    def _apply_demotions(self):
+        """Mixed-mode post-dispatch hygiene: run the queued simulated-
+        int8 block rewrites.  Queued at trie registration, applied only
+        after the dispatch that wrote the blocks' contents (wave
+        registration precedes its prefill; chunked registration follows
+        its chunk) — a demotion must never be overwritten by the prefill
+        it raced."""
+        if not self._pending_demote:
+            return
+        # drain in place: kv.on_demote holds a bound ``extend`` of THIS
+        # list, so rebinding the attribute would orphan the hook
+        pending = list(self._pending_demote)
+        self._pending_demote.clear()
+        for bid in pending:
+            self._cache = self._demote_fn(self._cache, jnp.int32(bid))
+        self._m_demoted.inc(len(pending))
+
     def _step_inner(self) -> List[int]:
         finished = self._admit()
         occ = int(self._active.sum())
@@ -1024,6 +1203,7 @@ class ServingEngine:
                         continue
                     # this tick writes K/V at positions[i]
                     self._grow_row_for_writes(i, int(self._positions[i]))
+                self._flush_fresh_scales()
                 nxt, self._cache = self._step_fn(
                     self._params, self._cache,
                     jnp.asarray(self._tokens), jnp.asarray(self._positions),
@@ -1121,6 +1301,7 @@ class ServingEngine:
                     self._grow_row_for_writes(
                         i, int(self._positions[i])
                         + int(draft_ok[i].sum()))
+                self._flush_fresh_scales()
                 out, n_acc, self._cache = self._step_fn(
                     self._params, self._cache, jnp.asarray(window),
                     jnp.asarray(self._positions), jnp.asarray(self._tables),
@@ -1271,6 +1452,7 @@ class ServingEngine:
                                                self.max_blocks)[None]
                 else:
                     ctable = np.zeros((1, self.max_blocks), np.int32)
+                self._flush_fresh_scales()
                 head = ((jnp.asarray(window), jnp.asarray(self._positions),
                          jnp.asarray(self._tables),
                          jnp.asarray(self._active), jnp.asarray(draft_ok))
@@ -1319,6 +1501,7 @@ class ServingEngine:
             finished.extend(self._advance_decode(np.asarray(nxt), now))
         if do_chunk:
             finished.extend(self._advance_chunk(pf, clen, int(ctok), now))
+        self._apply_demotions()
         return finished
 
     def _admit_chunked(self) -> List[int]:
@@ -1351,6 +1534,10 @@ class ServingEngine:
                 return []
             m = got                  # adopted prefix tokens skip compute
         self._queue.popleft()
+        if self.quantized and not self.paged:
+            # chunked admission streams into a reused row: drop the
+            # previous tenant's granule scales before the first chunk
+            self._cache = self._row_reset_fn(self._cache, jnp.int32(si))
         now = time.perf_counter()
         req.t_admit = now
         self._m_queue_wait.observe((now - req.t_submit) * 1e3)
@@ -1526,7 +1713,7 @@ class ServingEngine:
         they are tiny and every device needs them whole."""
         param_specs, cache_spec, _ = decode_mesh_specs(
             self._bind, self._params, minfo.names,
-            paged_cache=self.paged)
+            paged_cache=self.paged, quantized_cache=self.quantized)
         args = self._lint_args()
         specs = [None] * len(args)
         specs[0], specs[1] = param_specs, cache_spec
@@ -1661,6 +1848,14 @@ class ServingEngine:
                 "rel_err": round(rel, 6), "tol": tol,
                 "ok": bool(cache_ok and peak_ok)}
 
+    def observe_dequant_error(self, max_abs_logit_delta: float):
+        """Record one int8-KV parity-oracle observation — the max
+        absolute logit delta vs a bf16 reference run on the same trace —
+        into the ``serving.kv_dequant_error`` summary.  Called by the
+        oracle tests and the ``int8_serving`` bench section; the serving
+        hot path never computes logits twice."""
+        self._m_dequant_err.observe(float(max_abs_logit_delta))
+
     @property
     def cache_hbm_bytes(self) -> int:
         """Bytes of the KV cache (contiguous rows or paged pool) this
@@ -1757,6 +1952,11 @@ class ServingEngine:
             st = self.kv.stats
             total = self.prefill_tokens_total
             out["kv_cache"] = {
+                "kv_dtype": self.kv_dtype,
+                "quantized_blocks": self.kv.quantized_blocks(),
+                "bytes_by_dtype": {
+                    d: int(g.value())
+                    for d, g in self.kv._g_bytes.items()},
                 "blocks_in_use": self.kv.blocks_in_use(),
                 "peak_blocks_in_use": st["peak_blocks_in_use"],
                 "peak_pool_occupancy": round(
@@ -1929,6 +2129,7 @@ class ServingEngine:
         self._f_bucket.labels(engine=self._eid, bucket=str(bucket)).inc()
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
+        self._flush_fresh_scales()
         with self._tracer.span("serving.prefill", bucket=bucket,
                                rows=len(wave)):
             tok, self._cache = self._prefill_fn(
@@ -1937,6 +2138,7 @@ class ServingEngine:
                 jnp.asarray(tables), jnp.asarray(temps),
                 jnp.asarray(topk), jnp.asarray(topp), key)
             tok = np.asarray(tok)
+        self._apply_demotions()
         t_tok = time.perf_counter()
         finished: List[int] = []
         for r, (req, si, m) in enumerate(wave):

@@ -108,7 +108,8 @@ class _StatsView(Mapping):
     else."""
 
     _KEYS = ("prefix_lookups", "prefix_hit_blocks", "prefix_hit_tokens",
-             "evictions", "cow_copies", "peak_blocks_in_use")
+             "evictions", "cow_copies", "peak_blocks_in_use",
+             "quantized_blocks")
 
     def __init__(self, mgr: "BlockManager"):
         self._mgr = mgr
@@ -116,6 +117,8 @@ class _StatsView(Mapping):
     def __getitem__(self, key: str) -> int:
         if key == "peak_blocks_in_use":
             return self._mgr._peak
+        if key == "quantized_blocks":
+            return self._mgr.quantized_blocks()
         return int(self._mgr._counters[key].value())
 
     def __iter__(self):
@@ -128,11 +131,29 @@ class _StatsView(Mapping):
         return repr(dict(self))
 
 
-def init_paged_kv_cache(config, num_blocks: int, block_len: int, dtype=None):
+def init_paged_kv_cache(config, num_blocks: int, block_len: int, dtype=None,
+                        quantized: bool = False):
     """Pooled paged cache: (L, 2, num_blocks, block_len, kv_heads, head_dim)
-    — the contiguous cache's (B, max_len) plane re-cut into fixed blocks."""
+    — the contiguous cache's (B, max_len) plane re-cut into fixed blocks.
+
+    ``quantized``: the int8 pool — a two-leaf pytree
+    ``{"kv": int8 (L, 2, nb, bl, Hkv, D), "scale": f32 (L, 2, nb, Hkv)}``
+    where ``scale[l, kv, b, h]`` is physical block ``b``'s
+    per-kv-head symmetric dequant factor (absmax/127, running-max across
+    scatter-time writes).  Zero scale == empty block (dequantizes to 0).
+    The pytree threads through the engine's jitted step exactly like the
+    plain array (same argnum, donated wholesale).
+    """
     import jax.numpy as jnp
 
+    if quantized:
+        return {
+            "kv": jnp.zeros((config.num_hidden_layers, 2, num_blocks,
+                             block_len, config.num_key_value_heads,
+                             config.head_dim), jnp.int8),
+            "scale": jnp.zeros((config.num_hidden_layers, 2, num_blocks,
+                                config.num_key_value_heads), jnp.float32),
+        }
     dt = dtype if dtype is not None else config.dtype
     return jnp.zeros((config.num_hidden_layers, 2, num_blocks, block_len,
                       config.num_key_value_heads, config.head_dim), dt)
@@ -158,17 +179,44 @@ class BlockManager:
     """
 
     def __init__(self, num_blocks: int, block_len: int,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, kv_dtype: str = "bf16"):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the null block), "
                 f"got {num_blocks}")
         if block_len < 1:
             raise ValueError(f"block_len must be >= 1, got {block_len}")
+        if kv_dtype not in ("bf16", "int8", "mixed"):
+            raise ValueError(
+                f"kv_dtype must be bf16|int8|mixed, got {kv_dtype!r}")
         self.num_blocks = int(num_blocks)
         self.block_len = int(block_len)
         self.prefix_cache = bool(prefix_cache)
+        self.kv_dtype = kv_dtype
+        # per-block element dtype: 0 = the pool's native (bf16) dtype,
+        # 1 = int8.  A pure-int8 pool is born all-1; ``mixed`` blocks are
+        # born hot (0) and demote to 1 when they register as cold full
+        # prefix blocks (``on_demote`` fires so the engine can rewrite
+        # the device block); a freed block resets to the pool default.
+        self._default_dtype = 1 if kv_dtype == "int8" else 0
+        self._dtype = np.full(num_blocks, self._default_dtype, np.int8)
+        # engine hook: called with the list of newly demoted physical
+        # block ids (mixed mode only) so the device-side block rewrite —
+        # a host-triggered quantize→dequantize pass — happens exactly
+        # once per demotion, COW/refcount-safe because registration only
+        # covers immutable full prompt blocks
+        self.on_demote = None
+        # bytes per block, per element dtype — set by the engine (the
+        # manager has no model dims); feeds kv_cache.bytes_by_dtype
+        self._block_nbytes: Dict[str, int] = {}
         self._free: Deque[int] = deque(range(1, num_blocks))
+        # blocks newly appended to a chain since the last drain — an
+        # int8 engine zeroes their device scale rows before dispatch
+        # (a reused block's stale scale would otherwise inflate the
+        # running-max quantization scale for its new tenant).  COW
+        # destinations are excluded: the device copy carries the source
+        # block's live scale with it.
+        self._fresh: Set[int] = set()
         self._ref = np.zeros(num_blocks, np.int64)
         self._reserved = 0                       # admitted-but-unallocated
         self._slots: Dict[int, _SlotAlloc] = {}
@@ -218,6 +266,17 @@ class BlockManager:
             "kv_cache.cached_blocks",
             "retired prefix blocks parked for future hits "
             "(evictable)").labels(**lbl)
+        self._g_quant = reg.gauge(
+            "kv_cache.quantized_blocks",
+            "live (referenced or LRU-cached) blocks holding int8 "
+            "content").labels(**lbl)
+        self._f_bytes = reg.gauge(
+            "kv_cache.bytes_by_dtype",
+            "live pool bytes per element dtype (payload + scale share; "
+            "set once the engine provides per-block byte costs)")
+        self._g_bytes = {
+            "bf16": self._f_bytes.labels(dtype="bf16", **lbl),
+            "int8": self._f_bytes.labels(dtype="int8", **lbl)}
         self._stats_view = _StatsView(self)
         self._refresh_gauges()
 
@@ -246,6 +305,28 @@ class BlockManager:
 
     def free_blocks(self) -> int:
         return len(self._free)
+
+    def block_dtype(self, bid: int) -> str:
+        """Element dtype of physical block ``bid``'s contents."""
+        return "int8" if self._dtype[bid] else "bf16"
+
+    def quantized_blocks(self) -> int:
+        """Live (referenced or LRU-cached) blocks holding int8 content."""
+        live = self._live_mask()
+        return int((live & (self._dtype == 1)).sum())
+
+    def set_block_nbytes(self, by_dtype: Dict[str, int]):
+        """Engine-supplied per-block byte costs (payload + scale share)
+        keyed by element dtype — arms the ``kv_cache.bytes_by_dtype``
+        gauges (the manager itself has no model dimensions)."""
+        self._block_nbytes = {k: int(v) for k, v in by_dtype.items()}
+        self._refresh_gauges()
+
+    def _live_mask(self) -> np.ndarray:
+        live = self._ref > 0
+        if self._lru:
+            live[list(self._lru)] = True
+        return live
 
     def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         """Worst-case blocks a request needs over its whole lifetime
@@ -371,6 +452,7 @@ class BlockManager:
         decode and must stay private."""
         bl = self.block_len
         parent = _ROOT
+        demoted: List[int] = []
         for b in range(prompt_len // bl):
             bid = chain[b]
             key = (parent, tuple(prompt[b * bl:(b + 1) * bl]))
@@ -379,7 +461,20 @@ class BlockManager:
                 self._block_key[bid] = key
                 if parent != _ROOT:
                     self._children.setdefault(parent, set()).add(bid)
+                # mixed pool: a block registering as a shareable FULL
+                # prefix block is cold by definition (immutable from
+                # here on) — demote it to int8 now; the engine's
+                # on_demote device rewrite is refcount-safe because no
+                # writer ever touches a registered full block again
+                # (forks go through ensure_writable first)
+                if self.kv_dtype == "mixed" and not self._dtype[bid]:
+                    self._dtype[bid] = 1
+                    demoted.append(bid)
             parent = self._trie.get(key, bid)
+        if demoted:
+            if self.on_demote is not None:
+                self.on_demote(list(demoted))
+            self._refresh_gauges()
 
     # -- growth / writes ---------------------------------------------------
 
@@ -395,10 +490,20 @@ class BlockManager:
                 "(engine bug: reservation must cover prompt + max_new)")
         bid = self._pop_block()
         self._ref[bid] = 1
+        self._fresh.add(bid)
         st.chain.append(bid)
         st.reserved_left -= 1
         self._reserved -= 1
         return bid
+
+    def drain_fresh(self) -> List[int]:
+        """Physical ids of blocks newly appended to chains since the last
+        call (cleared on read).  The int8 engine zeroes these blocks'
+        device scale rows before the next step dispatch — see
+        ``_fresh``'s init comment for why reuse makes that necessary."""
+        out = sorted(self._fresh)
+        self._fresh.clear()
+        return out
 
     def ensure_capacity(self, slot: int, pos: int) -> bool:
         """Grow ``slot``'s chain until it covers position ``pos``.
@@ -445,10 +550,13 @@ class BlockManager:
             self._ref[bid] -= 1
             if self._ref[bid] == 0:
                 if bid in self._block_key:
+                    # LRU-parked: the content (and its dtype) persists
+                    # for future prefix hits
                     self._lru[bid] = None
                     self._lru.move_to_end(bid)
                 else:
                     self._free.append(bid)
+                    self._dtype[bid] = self._default_dtype
         self._refresh_gauges()
 
     def _evict_one(self) -> int:
@@ -463,6 +571,7 @@ class BlockManager:
         bid, _ = self._lru.popitem(last=False)
         self._counters["evictions"].inc()
         self._unregister_cascade(bid)
+        self._dtype[bid] = self._default_dtype  # new owner rewrites it
         return bid
 
     def _unregister_cascade(self, bid: int):
@@ -481,6 +590,7 @@ class BlockManager:
             if b != bid and b in self._lru:
                 del self._lru[b]
                 self._free.append(b)
+                self._dtype[b] = self._default_dtype
 
     def truncate_to(self, slot: int, pos: int):
         """Roll ``slot``'s chain back to cover exactly positions
@@ -530,6 +640,7 @@ class BlockManager:
                 # unregistered above, so never LRU-parked: straight back
                 # to the free list
                 self._free.append(bid)
+                self._dtype[bid] = self._default_dtype
         st.reserved_left += len(removed)
         self._reserved += len(removed)
         self._refresh_gauges()
@@ -564,4 +675,13 @@ class BlockManager:
         self._g_occ.set(used / self.usable_blocks)
         self._g_free.set(len(self._free))
         self._g_cached.set(len(self._lru))
+        live = self._live_mask()
+        n_int8 = int((live & (self._dtype == 1)).sum())
+        self._g_quant.set(n_int8)
+        if self._block_nbytes:
+            self._g_bytes["int8"].set(
+                n_int8 * self._block_nbytes.get("int8", 0))
+            self._g_bytes["bf16"].set(
+                (int(live.sum()) - n_int8)
+                * self._block_nbytes.get("bf16", 0))
         return used

@@ -228,10 +228,11 @@ def replay(target, load: Sequence[LoadRequest],
 # -- CI smoke ----------------------------------------------------------------
 
 def _smoke() -> int:
-    """Tiny seeded load against BOTH engine modes (wave and chunked),
-    each replayed twice on fresh engines: non-zero exit on a step
-    retrace past budget 1 or on any determinism drift (signature or
-    sampled outputs) between the identical-seed runs."""
+    """Tiny seeded load against the engine modes CI guards (wave,
+    chunked, paged int8-KV), each replayed twice on fresh engines:
+    non-zero exit on a step retrace past budget 1 or on any determinism
+    drift (signature or sampled outputs) between the identical-seed
+    runs."""
     import json
 
     import jax
@@ -254,7 +255,12 @@ def _smoke() -> int:
                     tenants=2, shared_prefix_len=4)
     load = generate_load(spec, seed=11)
 
-    modes = {"wave": {}, "chunked": {"chunked": True, "prefill_chunk": 8}}
+    modes = {"wave": {}, "chunked": {"chunked": True, "prefill_chunk": 8},
+             # quantized-cache drift canary (ISSUE 13): one paged int8-KV
+             # replay so a regression in the quantize-at-scatter /
+             # dequant-in-kernel path fails CI, not just the bench
+             "int8_paged": {"paged": True, "block_len": 16,
+                            "kv_cache_dtype": "int8"}}
     failures: List[str] = []
     summary: Dict[str, Any] = {"requests": spec.n_requests}
     for mode, kw in modes.items():

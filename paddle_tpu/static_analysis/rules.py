@@ -45,6 +45,14 @@ _WIDE_PAIRS = {("bfloat16", "float32"), ("bfloat16", "float64"),
                ("float16", "float32"), ("float16", "float64"),
                ("float32", "float64")}
 
+# int8 operands widening to float: the quantized KV cache DEQUANTIZES
+# inside the decode-attention kernel (on-chip, post-load) and its
+# reference parity path — deliberate and scoped by name.  Anywhere else
+# an int8->float convert materializes exactly the full-precision copy
+# the quantized store existed to avoid (4x the streamed bytes).
+_INT8_WIDE_PAIRS = {("int8", "bfloat16"), ("int8", "float16"),
+                    ("int8", "float32"), ("int8", "float64")}
+
 
 class Rule:
     """Base: ``name``/``severity`` class attrs + ``run(ctx)``."""
@@ -119,10 +127,19 @@ class DtypePromotionRule(Rule):
     the bytes a weight-stream-bound step must move.  Deliberate
     accumulators (softmax/norm reductions) live inside named regions;
     the ``allow`` list matches path substrings (pjit/remat regions carry
-    the traced function's name — see ``core.iter_eqns``)."""
+    the traced function's name — see ``core.iter_eqns``).
+
+    int8 operands get their own ``allow_int8`` scope: the quantized KV
+    cache's dequant widening belongs inside the decode-attention kernel
+    and its named reference path (``pjit[_dequant_decode_attention]``)
+    or the scatter-time quantize regions (``pjit[_quantized_*_write]``)
+    — an int8->float convert anywhere else rematerializes the bf16 copy
+    the int8 store was bought to avoid, and is flagged."""
 
     min_bytes: Optional[int] = None
-    allow: Tuple[str, ...] = ("softmax", "norm", "logsumexp")
+    allow: Tuple[str, ...] = ("softmax", "norm", "logsumexp",
+                              "quantized_")
+    allow_int8: Tuple[str, ...] = ("decode_attention", "quantized_")
 
     name = "dtype-promotion"
     severity = "warning"
@@ -139,21 +156,30 @@ class DtypePromotionRule(Rule):
             sd = getattr(src, "dtype", None)
             if sd is None or new is None:
                 continue
-            if (str(sd), str(new)) not in _WIDE_PAIRS:
+            pair = (str(sd), str(new))
+            if pair in _WIDE_PAIRS:
+                allow, hint = self.allow, (
+                    "if this is a softmax/norm accumulator, put it in a "
+                    "named region on the allowlist; otherwise it "
+                    "double-charges the memory-bound step")
+            elif pair in _INT8_WIDE_PAIRS:
+                allow, hint = self.allow_int8, (
+                    "quantized-KV dequantization belongs inside the "
+                    "decode_attention kernel/reference — dequantizing "
+                    "here rematerializes the full-precision copy the "
+                    "int8 store exists to avoid")
+            else:
                 continue
             nb = core.aval_bytes(src)
             if nb is None or nb < thr:
                 continue
-            if any(a in path for a in self.allow):
+            if any(a in path for a in allow):
                 continue
             wide = nb // sd.itemsize * np.dtype(new).itemsize
             out.append(self._finding(
                 path,
                 f"{src.str_short()} widened to {new} ({nb} -> {wide} "
-                f"bytes) on a low-precision path — if this is a "
-                f"softmax/norm accumulator, put it in a named region "
-                f"on the allowlist; otherwise it double-charges the "
-                f"memory-bound step",
+                f"bytes) on a low-precision path — {hint}",
                 bytes=wide))
         return out
 
