@@ -419,6 +419,18 @@ def run_op_decode_attention(steps):
                    "chosen_path": path}
             if why:
                 row["fallback_reason"] = why
+            if path == "pallas_decode":
+                # kernel pre-flight (ISSUE 14) for the exact spec this
+                # row's dispatch selected — static, rides the row so
+                # BENCH_DECODE.json carries the VMEM/streamed evidence
+                from paddle_tpu.static_analysis import (
+                    analyze_kernels, decode_attention_spec, kernel_report)
+                kspec = decode_attention_spec(b, 1, hq, hkv, d, kv_len=L)
+                kr = kernel_report(kspec)
+                row["kernel_preflight"] = {
+                    "vmem_bytes": kr["vmem_bytes"],
+                    "streamed_bytes": kr["streamed_bytes"],
+                    "findings": len(kr["findings"])}
             rows.append(row)
             print(f"[decode-attn] b={b} L={L} depth={depth}: "
                   f"xla {t_ref*1e3:.3f} ms, pallas {t_pal*1e3:.3f} ms "
@@ -453,12 +465,23 @@ def run_op_decode_attention(steps):
                     q_, k_, v_, pos, k_scale=ks_, v_scale=vs_,
                     interpret=interpret),
                 (q, k8, v8, ks, vs), steps_eff, extra=extra)
-            rows.append(dict(row, dtype="int8+f32scale",
-                             cache="int8",
-                             xla_ms=round(t_ref8 * 1e3, 4),
-                             pallas_ms=round(t_pal8 * 1e3, 4),
-                             speedup=(round(t_ref8 / t_pal8, 3)
-                                      if t_pal8 else None)))
+            row8 = dict(row, dtype="int8+f32scale",
+                        cache="int8",
+                        xla_ms=round(t_ref8 * 1e3, 4),
+                        pallas_ms=round(t_pal8 * 1e3, 4),
+                        speedup=(round(t_ref8 / t_pal8, 3)
+                                 if t_pal8 else None))
+            if path == "pallas_decode":
+                from paddle_tpu.static_analysis import (
+                    decode_attention_spec, kernel_report)
+                kr8 = kernel_report(decode_attention_spec(
+                    b, 1, hq, hkv, d, kv_len=L, quantized=True,
+                    n_granules=ng))
+                row8["kernel_preflight"] = {
+                    "vmem_bytes": kr8["vmem_bytes"],
+                    "streamed_bytes": kr8["streamed_bytes"],
+                    "findings": len(kr8["findings"])}
+            rows.append(row8)
             print(f"[decode-attn] b={b} L={L} depth={depth} int8: "
                   f"xla {t_ref8*1e3:.3f} ms, pallas {t_pal8*1e3:.3f} ms",
                   file=sys.stderr)
@@ -833,6 +856,21 @@ def _mesh_preflight_row(eng, mesh="mp2dp2"):
             "cache_check": pf["cache_check"]}
 
 
+def _kernel_preflight_row(eng):
+    """Kernel pre-flight snapshot (ISSUE 14, BASELINE.md "Kernel
+    pre-flight conventions"): static VMEM/bounds/alignment/
+    streamed-bytes analysis of the Pallas kernels this engine's
+    dispatch would select, projected to the TPU-eligible geometry — no
+    compile, no device.  findings must be 0: the serving layouts are
+    pre-validated against kernel VMEM OOMs and index-map bugs before
+    the TPU re-runs (growth_check_b8, int8_serving.tpu_recheck)."""
+    kp = eng.kernel_preflight()
+    return {"vmem_bytes": kp["vmem_bytes"],
+            "vmem_budget_frac": kp["vmem_budget_frac"],
+            "streamed_bytes": kp["streamed_bytes"],
+            "findings": len(kp["findings"])}
+
+
 def _serving_bench(model, on_tpu):
     """Continuous-batching engine under a Poisson-ish synthetic arrival
     trace (paddle_tpu/serving): exponential inter-arrival gaps measured
@@ -901,6 +939,10 @@ def _serving_bench(model, on_tpu):
            # for the mp2dp2 deployment it will run under when ROADMAP
            # item 1 lands — predicted comm + per-device HBM, 0 findings
            "mesh_preflight": _mesh_preflight_row(eng),
+           # kernel pre-flight (ISSUE 14): the Pallas kernels this
+           # layout's dispatch would select, statically checked for
+           # VMEM fit / bounds / alignment — 0 findings
+           "kernel_preflight": _kernel_preflight_row(eng),
            # SLO snapshot straight from the observability registry (the
            # engine's own series; BASELINE.md conventions) — TTFT/TPOT/
            # queue-wait percentiles span BOTH passes, so the warm pass's
@@ -1175,6 +1217,7 @@ def _paged_serving_bench(model, on_tpu):
             "prefill_traces": eng.prefill_traces,
             "cache_hbm": _cache_hbm_row(eng),
             "mesh_preflight": _mesh_preflight_row(eng),
+            "kernel_preflight": _kernel_preflight_row(eng),
             # registry snapshot: percentiles + the pool's cache
             # accounting (metrics.kv_cache.prefix_hit_rate uses admitted
             # prompt tokens as denominator, so it matches the

@@ -293,6 +293,15 @@ DEFINE("graph_lint_hbm_tol", 0.02,
        "by the cache's shard count, must match the engine's "
        "cache_hbm_bytes within this relative error or the pre-flight "
        "report carries an hbm-liveness error finding")
+# kernel pre-flight (paddle_tpu/static_analysis/kernel_rules.py): static
+# VMEM/bounds/alignment analysis of every registered Pallas KernelSpec —
+# no compile, no device (BASELINE.md "Kernel pre-flight conventions")
+DEFINE("kernel_lint_vmem_bytes", 16 * 1024 * 1024,
+       "kernel-vmem rule budget: a kernel's per-grid-step VMEM "
+       "footprint (block-shaped operand tiles with streamed operands "
+       "double-buffered x2, plus scratch accumulators) must fit this "
+       "per-core budget or the pre-flight carries an error finding; "
+       "16 MiB is the v4/v5-generation VMEM per core")
 # observability (paddle_tpu/observability): metrics registry + span tracer
 DEFINE("retrace_watchdog", "warn",
        "action when a track_retraces call-site compiles past its trace "

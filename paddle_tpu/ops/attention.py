@@ -183,6 +183,38 @@ def _mesh_sharded_trace() -> bool:
     return True
 
 
+def decode_shape_gate(s, hq, hkv, d, kv_len, paged_block_len=None):
+    """The SHAPE-only half of the flash-decode dispatch decision: would
+    this geometry fit the Pallas kernel, ignoring environment (backend,
+    mesh trace, extra masks, the min_len perf threshold)?  Every bound
+    derives from ``ops.pallas.limits`` — the same module the kernel's
+    own gates read — and the kernel-registry's dispatch-agreement lint
+    (``static_analysis.kernel_rules.dispatch_agreement_findings``)
+    sweeps a shape lattice to prove the two stay in step.  Returns
+    ``("pallas_decode", None)`` or ``("xla_math", reason)``."""
+    from .pallas import limits as _limits
+    if hkv == 0 or hq % hkv:
+        return "xla_math", f"q heads {hq} not a multiple of kv heads {hkv}"
+    if hq // hkv > _limits.MAX_Q_ROWS:
+        return "xla_math", (f"GQA group size {hq // hkv} > "
+                            f"{_limits.MAX_Q_ROWS}")
+    if s > _limits.MAX_Q_LEN:
+        # a q longer than any serving prefill chunk is whole-prompt
+        # prefill — the flash kernel's regime, not the cached path's
+        return "xla_math", (f"q_len {s} > {_limits.MAX_Q_LEN} "
+                            f"(whole-prefill-shaped)")
+    if d > _limits.MAX_HEAD_DIM:
+        return "xla_math", f"head_dim {d} > {_limits.MAX_HEAD_DIM}"
+    if paged_block_len is not None:
+        if paged_block_len % _limits.LANES:
+            return "xla_math", (f"paged block_len {paged_block_len} not "
+                                f"128-aligned")
+        return "pallas_decode", None
+    if kv_len % _limits.LANES:
+        return "xla_math", f"max_length {kv_len} not 128-aligned"
+    return "pallas_decode", None
+
+
 def _decode_attention_decision(b, s, hq, hkv, d, kv_len, has_extra_mask,
                                paged_block_len):
     from .. import flags as _flags
@@ -199,24 +231,7 @@ def _decode_attention_decision(b, s, hq, hkv, d, kv_len, has_extra_mask,
         return "xla_math", (f"kv_len {kv_len} < "
                             f"FLAGS_decode_attention_min_len (XLA at the "
                             f"weight-stream bound there)")
-    if hkv == 0 or hq % hkv:
-        return "xla_math", f"q heads {hq} not a multiple of kv heads {hkv}"
-    if hq // hkv > 64:
-        return "xla_math", f"GQA group size {hq // hkv} > 64"
-    if s > 2048:
-        # a q longer than any serving prefill chunk is whole-prompt
-        # prefill — the flash kernel's regime, not the cached path's
-        return "xla_math", f"q_len {s} > 2048 (whole-prefill-shaped)"
-    if d > 256:
-        return "xla_math", f"head_dim {d} > 256"
-    if paged_block_len is not None:
-        if paged_block_len % 128:
-            return "xla_math", (f"paged block_len {paged_block_len} not "
-                                f"128-aligned")
-        return "pallas_decode", None
-    if kv_len % 128:
-        return "xla_math", f"max_length {kv_len} not 128-aligned"
-    return "pallas_decode", None
+    return decode_shape_gate(s, hq, hkv, d, kv_len, paged_block_len)
 
 
 def cached_decode_attention(q, k_cache, v_cache, pos,

@@ -96,11 +96,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._compat import CompilerParams
+from . import limits as _limits
 
 NEG_INF = -1e30
-_LANES = 128  # VPU lane width: m/l scratch rows are padded to this
-_MAX_Q_ROWS = 64  # per-TILE s·G row cap — larger q is tiled over the grid
-_MAX_Q_LEN = 2048  # beyond this the shape is whole-prefill, flash territory
+# shape bounds live in ops/pallas/limits.py — ONE source of truth shared
+# with the dispatch gate (ops.attention.decode_shape_gate) and the
+# kernel pre-flight (static_analysis/kernel_registry.py); the
+# dispatch-agreement lint proves the three stay in step
+_LANES = _limits.LANES  # VPU lane width: m/l scratch rows padded to this
+_MAX_Q_ROWS = _limits.MAX_Q_ROWS  # per-TILE s·G row cap — larger q tiles
+_MAX_Q_LEN = _limits.MAX_Q_LEN  # beyond this: whole-prefill, flash territory
 
 
 def _pick_block_kv(kv_len: int, cap: int) -> int:
@@ -262,8 +267,9 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         raise NotImplementedError(
             f"q_len {s} > {_MAX_Q_LEN}: whole-prefill-shaped q belongs to "
             f"the flash kernel")
-    if d > 256:
-        raise NotImplementedError(f"head_dim {d} > 256")
+    if d > _limits.MAX_HEAD_DIM:
+        raise NotImplementedError(
+            f"head_dim {d} > {_limits.MAX_HEAD_DIM}")
     # q tiling: one grid step covers bq query tokens (bq·g MXU rows).
     # s <= bq is the steady-decode / small-s case — nq == 1, exactly the
     # original kernel.  Larger s (a chunked-prefill q chunk attending its
@@ -353,7 +359,13 @@ def decode_attention_pallas(q, k_cache, v_cache, pos,
         # clamp the LOGICAL chunk index to this q tile's last live block,
         # then dereference the block table: dead-tail chunks re-map to the
         # same physical block as the previous grid step → Pallas elides
-        # the DMA, so HBM traffic stops at the tile's live prefix
+        # the DMA, so HBM traffic stops at the tile's live prefix.
+        # Null-block aliasing rule (checked statically by the kernel
+        # pre-flight's ClampCheck and asserted by kv_cache.table_row):
+        # dead-tail table columns past `last` MAY hold NULL_BLOCK (0) —
+        # the clamp guarantees they are never dereferenced — but a LIVE
+        # column (<= last) mapping to block 0 would alias the null
+        # block's pad data into this row's attention window.
         last = (pos_ref[bi] + jnp.minimum((qi + 1) * bq, s) - 1) // bk
         return (bt_ref[bi, jnp.minimum(ki, last)], 0, 0)
 

@@ -38,7 +38,9 @@ from jax.experimental.pallas import tpu as pltpu
 from ._compat import CompilerParams
 
 NEG_INF = -1e30
-_LANES = 128  # VPU lane width: m/l scratch rows are padded to this
+from . import limits as _limits
+
+_LANES = _limits.LANES  # VPU lane width: m/l scratch rows padded to this
 
 
 def _aligned_divisor(seq: int, cap: int, align: int) -> int:

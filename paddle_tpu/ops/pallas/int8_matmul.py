@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import limits as _limits
+
 
 def _kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, k_steps: int):
     ki = pl.program_id(1)
@@ -87,10 +89,11 @@ def int8_matmul_pallas(x, w8, scale, block_k: int = 0, block_n: int = 0,
     rows_p = max(8, -(-rows // 8) * 8)
     if rows_p != rows:
         x2 = jnp.pad(x2, ((0, rows_p - rows), (0, 0)))
-    if rows_p > 256:
+    if rows_p > _limits.MAX_GEMM_ROWS:
         raise NotImplementedError(
-            f"decode-shaped kernel: row count {rows} > 256 (training-size "
-            f"GEMMs belong to XLA's own int8 handling)")
+            f"decode-shaped kernel: row count {rows} > "
+            f"{_limits.MAX_GEMM_ROWS} (training-size GEMMs belong to "
+            f"XLA's own int8 handling)")
     bk = block_k or _pick(k, 2048)
     bn = block_n or _pick(n, 512)
     k_steps = k // bk

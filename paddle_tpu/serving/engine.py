@@ -525,6 +525,7 @@ class ServingEngine:
         self._next_rid = 0
         self._base_key = jax.random.key(seed)
         self._ticks = 0
+        self._kernel_preflight_cache = None  # memoized kernel_preflight()
         # trace accounting rides the retrace watchdog
         # (observability/watchdog.py): the wrapper counts compilations —
         # python side effects fire at TRACE time only — into the shared
@@ -1697,14 +1698,112 @@ class ServingEngine:
         step under this engine's DECLARED shardings
         (:func:`~paddle_tpu.models.generation.decode_mesh_specs`) —
         the same layout ``_place_on_mesh`` commits when a hybrid mesh
-        is active, checked without any devices."""
+        is active, checked without any devices.
+
+        The KERNEL pre-flight (ISSUE 14) rides the same call: the
+        findings of :meth:`kernel_preflight` — the Pallas kernels this
+        engine's dispatch would select at TPU scale — merge into the
+        returned list under the shared deterministic ordering."""
         from .. import static_analysis as _sa
         if mesh is None:
-            return _sa.analyze(self._step_fn, *self._lint_args())
-        minfo = _sa.MeshInfo.of(mesh)
-        return _sa.analyze(self._step_fn, *self._lint_args(),
-                           mesh=minfo,
-                           in_shardings=self._mesh_step_shardings(minfo))
+            graph = _sa.analyze(self._step_fn, *self._lint_args())
+        else:
+            minfo = _sa.MeshInfo.of(mesh)
+            graph = _sa.analyze(
+                self._step_fn, *self._lint_args(), mesh=minfo,
+                in_shardings=self._mesh_step_shardings(minfo))
+        findings = list(graph) + list(self.kernel_preflight()["findings"])
+        return _sa._sort_findings(findings)
+
+    def _kernel_specs(self):
+        """The KernelSpecs this engine's dispatch would select, PROJECTED
+        to the Pallas-eligible regime.  Test configs run tiny CPU
+        geometry (head_dim 16, max_length 64) that dispatch routes to
+        XLA math; the kernels only ever see TPU-scale shapes, so the
+        pre-flight analyzes this engine's LAYOUT (paged/contiguous,
+        chunked/spec q shapes, kv dtype, block structure) at the
+        smallest geometry the kernel would actually accept: head_dim
+        rounded up to one lane tile, cache length up to
+        FLAGS_decode_attention_min_len, paged block_len up to 128.
+        A 'mixed' pool keeps bf16 device blocks (only 'int8' changes
+        program shapes), so mixed engines get the bf16 specs."""
+        from .. import static_analysis as _sa
+        lanes = 128
+        c = self.config
+        hkv = int(c.num_key_value_heads)
+        hq = int(c.num_attention_heads)
+        d_p = max(lanes, -(-int(c.head_dim) // lanes) * lanes)
+        min_len = int(_flags.flag("decode_attention_min_len"))
+        quantized = self.quantized
+        layout = "paged" if self.paged else "contiguous"
+        # q shapes per step mode: the decode rows (or the spec-verify
+        # window), plus the chunked-prefill q chunk when armed
+        shapes = [(self.num_slots, self.spec_k + 1, "spec_verify")
+                  if self.spec else (self.num_slots, 1, "decode")]
+        if self.chunked:
+            shapes.append((1, self.prefill_chunk, "chunked_prefill"))
+        specs = []
+        for b, s, label in shapes:
+            tag = (f"{layout}{'+int8' if quantized else ''},"
+                   f"{label},s={s}")
+            if self.paged:
+                bl_p = max(lanes, -(-self.block_len // lanes) * lanes)
+                mb_p = max(self.max_blocks, -(-min_len // bl_p))
+                specs.append(_sa.decode_attention_spec(
+                    b, s, hq, hkv, d_p, block_len=bl_p,
+                    max_blocks=mb_p,
+                    num_blocks=self.num_slots * mb_p + 1,
+                    quantized=quantized, variant=tag))
+            else:
+                kv_p = max(min_len,
+                           -(-self.max_length // lanes) * lanes)
+                specs.append(_sa.decode_attention_spec(
+                    b, s, hq, hkv, d_p, kv_len=kv_p,
+                    quantized=quantized,
+                    # init_kv_cache's granule layout: one scale per
+                    # 128-token granule (kv_p is lane-aligned above)
+                    n_granules=kv_p // lanes if quantized else None,
+                    variant=tag))
+        return specs
+
+    def kernel_preflight(self, rules=None) -> Dict[str, object]:
+        """Static pre-flight of the Pallas kernels this engine's
+        dispatch would select (ISSUE 14): per-kernel VMEM footprint,
+        index-map bounds, alignment, and streamed-bytes checks — no
+        compile, no device.  Returns ``{"findings", "kernels",
+        "vmem_bytes" (max over kernels), "vmem_budget_bytes",
+        "vmem_budget_frac", "streamed_bytes" (sum)}`` and publishes the
+        ``kernels.predicted_*`` gauges.  Memoized for the default rule
+        set (the specs depend only on ctor config)."""
+        from .. import static_analysis as _sa
+        if rules is None and self._kernel_preflight_cache is not None:
+            return self._kernel_preflight_cache
+        specs = self._kernel_specs()
+        findings = _sa.analyze_kernels(specs, rules=rules)
+        reports = [_sa.kernel_report(s, rules=rules) for s in specs]
+        budget = int(_flags.flag("kernel_lint_vmem_bytes"))
+        vmem = max((r["vmem_bytes"] for r in reports), default=0)
+        streamed = sum(r["streamed_bytes"] for r in reports)
+        out = {
+            "findings": findings,
+            "kernels": reports,
+            "vmem_bytes": int(vmem),
+            "vmem_budget_bytes": budget,
+            "vmem_budget_frac": (vmem / budget) if budget else 0.0,
+            "streamed_bytes": int(streamed),
+        }
+        reg = _obs.default_registry()
+        reg.gauge("kernels.predicted_vmem_bytes",
+                  "max per-grid-step VMEM footprint over the engine's "
+                  "pre-flighted kernels").labels(
+                      engine=self._eid).set(float(vmem))
+        reg.gauge("kernels.predicted_streamed_bytes",
+                  "summed per-call streamed-bytes model over the "
+                  "engine's pre-flighted kernels").labels(
+                      engine=self._eid).set(float(streamed))
+        if rules is None:
+            self._kernel_preflight_cache = out
+        return out
 
     def _mesh_step_shardings(self, minfo):
         """Per-arg declared shardings for the step signature: params and

@@ -649,12 +649,25 @@ class BlockManager:
 
     def table_row(self, slot: int, max_blocks: int) -> np.ndarray:
         """(max_blocks,) int32 physical ids, null-block-filled past the
-        allocated chain (every entry is a valid pool index)."""
+        allocated chain (every entry is a valid pool index).
+
+        Null-block aliasing rule (ISSUE 14; the kernel pre-flight's
+        ClampCheck proves the other half): PAD columns past the chain
+        may map to ``NULL_BLOCK`` — the decode kernel's dead-tail clamp
+        guarantees they are never dereferenced — but a LIVE chain entry
+        mapping to block 0 would alias the null block's pad data into
+        the row's attention window, silently corrupting the output.
+        The allocator can never produce one (block 0 is excluded from
+        the free list at construction), so this is asserted, not
+        handled."""
         st = self._slots[slot]
         if len(st.chain) > max_blocks:
             raise ValueError(
                 f"slot {slot} chain ({len(st.chain)} blocks) exceeds "
                 f"max_blocks ({max_blocks})")
+        assert NULL_BLOCK not in st.chain, (
+            f"slot {slot} chain references the null block: live rows "
+            f"must never map to block 0 (pad aliasing)")
         row = np.full((max_blocks,), NULL_BLOCK, np.int32)
         row[:len(st.chain)] = st.chain
         return row

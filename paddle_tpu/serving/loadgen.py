@@ -265,9 +265,20 @@ def _smoke() -> int:
     summary: Dict[str, Any] = {"requests": spec.n_requests}
     for mode, kw in modes.items():
         runs = []
+        kernel_findings = -1
         for _ in range(2):
             eng = ServingEngine(model, num_slots=4, max_length=128,
                                 prefill_batch=2, **kw)
+            if kernel_findings < 0:
+                # ISSUE 14 CI gate: the kernels this mode's dispatch
+                # would select must pre-flight clean (static — no
+                # compile), so a kernel-lint regression fails the smoke
+                kf = eng.kernel_preflight()["findings"]
+                kernel_findings = len(kf)
+                if kf:
+                    failures.append(
+                        f"{mode}: kernel pre-flight findings: "
+                        + "; ".join(str(f) for f in kf))
             runs.append(replay(eng, load))
         a, b = runs
         traces = max(max(r["step_traces"]) for r in runs)
@@ -284,6 +295,7 @@ def _smoke() -> int:
             "generated_tokens": a["generated_tokens"],
             "step_traces": traces,
             "goodput": a["slo"]["goodput"],
+            "kernel_findings": kernel_findings,
             "deterministic": (a["signature"] == b["signature"]
                               and a["outputs"] == b["outputs"])}
     summary["failures"] = failures

@@ -59,9 +59,20 @@ from typing import List, Optional, Sequence
 
 from .. import flags as _flags
 from . import core, mesh_rules as _mesh_rules, rules
+from . import kernel_registry, kernel_rules
 from .core import (Finding, GraphLintError, GraphLintWarning,
                    LintContext, MeshInfo, MeshLintContext, trace_for_lint,
                    trace_for_mesh_lint)
+from .kernel_registry import (KernelSpec, KernelSpecError,
+                              decode_attention_spec, flash_attention_spec,
+                              int8_matmul_spec, rms_norm_spec,
+                              registered_kernel_specs, streamed_bytes,
+                              vmem_footprint)
+from .kernel_rules import (KernelRule, KernelVmemRule, KernelBoundsRule,
+                           KernelAlignRule, KernelScaleGranuleRule,
+                           KernelStreamRule, analyze_kernels,
+                           default_kernel_rules,
+                           dispatch_agreement_findings, kernel_report)
 from .mesh_rules import (CollectiveDeadlockRule, ReplicationBlowupRule,
                          ReshardingHazardRule, comm_report,
                          default_mesh_rules, estimate_peak_hbm)
@@ -78,6 +89,14 @@ __all__ = [
     "estimate_peak_hbm", "preflight",
     "analyze", "check", "enforce", "report", "trace_for_lint",
     "trace_for_mesh_lint",
+    # kernel pre-flight (ISSUE 14)
+    "KernelSpec", "KernelSpecError", "decode_attention_spec",
+    "flash_attention_spec", "int8_matmul_spec", "rms_norm_spec",
+    "registered_kernel_specs", "vmem_footprint", "streamed_bytes",
+    "KernelRule", "KernelVmemRule", "KernelBoundsRule",
+    "KernelAlignRule", "KernelScaleGranuleRule", "KernelStreamRule",
+    "default_kernel_rules", "analyze_kernels", "kernel_report",
+    "dispatch_agreement_findings",
 ]
 
 # findings sort: errors first, then a total deterministic order so two
@@ -121,7 +140,7 @@ def _trace(fn, args, kwargs, donate_argnums, donate_argnames,
 
 def analyze(fn, *args, donate_argnums=None, donate_argnames=None,
             rules: Optional[Sequence[Rule]] = None,
-            mesh=None, in_shardings=None,
+            mesh=None, in_shardings=None, kernels=None,
             **kwargs) -> List[Finding]:
     """Trace ``fn`` abstractly and run the graph-lint rules; returns
     findings (errors first, deterministically ordered) without raising.
@@ -141,7 +160,15 @@ def analyze(fn, *args, donate_argnums=None, donate_argnames=None,
     (replication-blowup / resharding-hazard / collective-deadlock)
     runs alongside the base rules.  ``mesh`` may be a jax
     ``Mesh``/``AbstractMesh``, a ``{axis: size}`` dict, or a string
-    like ``"mp2dp2"`` — no devices are needed."""
+    like ``"mp2dp2"`` — no devices are needed.
+
+    ``kernels=`` (ISSUE 14) adds the KERNEL pre-flight to the same
+    pass: a sequence of :class:`KernelSpec`\\ s (usually the specs the
+    traced program's dispatch would select —
+    ``ServingEngine._kernel_specs``) run through the kernel rule set
+    (VMEM footprint / index-map bounds / alignment / scale-granule /
+    streamed-bytes); their findings merge into the same deterministic
+    order."""
     ctx = _trace(fn, args, kwargs, donate_argnums, donate_argnames,
                  mesh, in_shardings)
     if rules is None:
@@ -150,6 +177,8 @@ def analyze(fn, *args, donate_argnums=None, donate_argnames=None,
     findings: List[Finding] = []
     for rule in rules:
         findings.extend(rule.run(ctx))
+    if kernels:
+        findings.extend(kernel_rules.analyze_kernels(kernels))
     return _sort_findings(findings)
 
 
@@ -173,13 +202,18 @@ def check(fn, *args, **kwargs) -> List[Finding]:
 def preflight(fn, *args, mesh, in_shardings=None,
               donate_argnums=None, donate_argnames=None,
               rules: Optional[Sequence[Rule]] = None,
+              kernels=None,
               **kwargs) -> dict:
     """Full mesh pre-flight of one traced program: findings (base +
     mesh rules), the per-axis collective-cost report, and the
     per-device HBM-liveness estimate — all from ONE abstract trace.
     This is the report ``ServingEngine.mesh_preflight`` wraps and the
     ``--mesh`` CLI prints; see BASELINE.md "Mesh pre-flight
-    conventions" for the accounting definitions."""
+    conventions" for the accounting definitions.
+
+    ``kernels=``: optional :class:`KernelSpec` sequence to pre-flight
+    alongside; their findings merge into ``"findings"`` and the
+    per-spec reports ride under ``"kernels"``."""
     ctx = _trace(fn, args, kwargs, donate_argnums, donate_argnames,
                  mesh, in_shardings)
     if rules is None:
@@ -187,12 +221,16 @@ def preflight(fn, *args, mesh, in_shardings=None,
     findings: List[Finding] = []
     for rule in rules:
         findings.extend(rule.run(ctx))
+    out = {"mesh": ctx.mesh.as_dict(),
+           "fn": ctx.fn_name,
+           "findings": findings,
+           "comm": comm_report(ctx),
+           "hbm": estimate_peak_hbm(ctx)}
+    if kernels:
+        findings.extend(kernel_rules.analyze_kernels(kernels))
+        out["kernels"] = [kernel_rules.kernel_report(s) for s in kernels]
     _sort_findings(findings)
-    return {"mesh": ctx.mesh.as_dict(),
-            "fn": ctx.fn_name,
-            "findings": findings,
-            "comm": comm_report(ctx),
-            "hbm": estimate_peak_hbm(ctx)}
+    return out
 
 
 def enforce(findings: Sequence[Finding],

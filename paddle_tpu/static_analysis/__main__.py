@@ -17,9 +17,16 @@ printed, the HBM prediction is cross-checked against the engine's
 more layout.  The mesh is ABSTRACT — the axes need not exist on this
 host, so a laptop can pre-flight a pod topology.
 
+``--kernels`` (ISSUE 14) arms the KERNEL pre-flight: every layout's
+``kernel_preflight`` block (static VMEM/bounds/alignment/streamed-bytes
+analysis of the Pallas kernels its dispatch would select), an int8-kv
+twin of every layout (the quantized pool changes kernel signatures),
+and a standalone ``registered_kernels`` sweep over the TPU-scale
+registry plus the dispatch-agreement lint.  Composes with ``--mesh``.
+
 This is the CI smoke for the "zero findings on the serving hot path"
-contract (ISSUE 6/8 acceptance): the same lint the engines self-run at
-their first tick under ``FLAGS_graph_lint``, invocable standalone.
+contract (ISSUE 6/8/14 acceptance): the same lint the engines self-run
+at their first tick under ``FLAGS_graph_lint``, invocable standalone.
 """
 
 from __future__ import annotations
@@ -32,8 +39,11 @@ from typing import List, Optional
 # --json output contract: bump when the blob SHAPE changes.  v1 was the
 # unversioned ISSUE-6 {layout: [findings]} mapping; v2 nests per-layout
 # reports under "layouts" and adds the mesh pre-flight blocks; v3 adds
-# the optional per-layout "execute" block (--mesh ... --execute).
-SCHEMA_VERSION = 3
+# the optional per-layout "execute" block (--mesh ... --execute); v4
+# (ISSUE 14) adds the contiguous+chunked+spec layout and, under
+# --kernels, per-layout "kernel_preflight" blocks, int8-kv twin
+# layouts, and the standalone "registered_kernels" entry.
+SCHEMA_VERSION = 4
 
 _EPILOG = """\
 exit status: 0 = every layout linted clean (and, with --mesh, every
@@ -76,6 +86,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "the step must compile once, and the placed "
                          "footprints must match the pre-flight "
                          "prediction; any drift exits non-zero")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also run the KERNEL pre-flight (ISSUE 14): "
+                         "per-layout static VMEM/bounds/alignment/"
+                         "streamed-bytes analysis of the Pallas kernels "
+                         "each engine's dispatch would select, an "
+                         "int8-kv twin of every layout, and the "
+                         "registered-kernel registry sweep with the "
+                         "dispatch-agreement lint — no compile, no "
+                         "device")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report instead of text "
                          "(schema_version %d; see epilog)"
@@ -119,7 +138,17 @@ def main(argv: Optional[List[str]] = None) -> int:
          dict(paged=True, block_len=args.block_len, chunked=True,
               prefill_chunk=args.prefill_chunk, spec_decode=True,
               spec_k=args.spec_k)),
+        ("contiguous+chunked+spec",
+         dict(chunked=True, prefill_chunk=args.prefill_chunk,
+              spec_decode=True, spec_k=args.spec_k)),
     ]
+    if args.kernels:
+        # the int8 KV pool changes the kernel signatures (scale
+        # operands, int8 streamed tiles) — pre-flight every layout's
+        # quantized twin too, so the acceptance sweep covers both
+        # cache dtypes
+        variants += [(f"{name}+int8kv", dict(kw, kv_cache_dtype="int8"))
+                     for name, kw in list(variants)]
     exec_trace = None
     if args.execute:
         import numpy as np
@@ -142,16 +171,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                             max_length=args.max_length, **kw)
         entry = {"cache_hbm_bytes": int(eng.cache_hbm_bytes)}
         if minfo is None:
+            # lint_step already merges the kernel pre-flight findings
             findings = eng.lint_step()
         else:
             pf = eng.mesh_preflight(minfo)
-            findings = pf["findings"]
+            findings = list(pf["findings"])
+            if args.kernels:
+                findings += list(eng.kernel_preflight()["findings"])
             entry["comm_bytes_per_step"] = {
                 a: row["bytes_per_step"]
                 for a, row in pf["comm"]["per_axis"].items()}
             entry["peak_hbm_bytes_per_device"] = (
                 pf["hbm"]["peak_bytes_per_device"])
             entry["cache_check"] = pf["cache_check"]
+        if args.kernels:
+            kp = eng.kernel_preflight()
+            entry["kernel_preflight"] = {
+                "vmem_bytes": kp["vmem_bytes"],
+                "vmem_budget_frac": kp["vmem_budget_frac"],
+                "streamed_bytes": kp["streamed_bytes"],
+                "findings": [f.as_dict() for f in kp["findings"]]}
         entry["findings"] = [f.as_dict() for f in findings]
         if args.execute:
             entry["execute"], nfail = _execute_layout(
@@ -162,6 +201,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not args.json:
             _print_layout(f"serving.step[{name}]", entry, findings,
                           report)
+
+    if args.kernels:
+        # the registry sweep: every registered TPU-scale kernel variant
+        # plus satellite 1's dispatch-agreement lint over the shape
+        # lattice — independent of any engine config
+        from . import (analyze_kernels, dispatch_agreement_findings,
+                       kernel_report, registered_kernel_specs)
+        specs = registered_kernel_specs()
+        reg_findings = (analyze_kernels(specs)
+                        + dispatch_agreement_findings())
+        layouts["registered_kernels"] = {
+            "kernels": [kernel_report(s) for s in specs],
+            "findings": [f.as_dict() for f in reg_findings]}
+        total += len(reg_findings)
+        if not args.json:
+            status = "clean" if not reg_findings else "FINDINGS"
+            print(f"[kernel-preflight] registered_kernels "
+                  f"({len(specs)} specs + dispatch agreement): {status}")
+            if reg_findings:
+                print(report(reg_findings, context="registered_kernels"))
 
     if minfo is not None:
         entry, findings = _mesh_decode_step_entry(
@@ -227,11 +286,15 @@ def _print_layout(label, entry, findings, report):
     cache_mb = entry["cache_hbm_bytes"] / 1e6
     status = "clean" if not findings else "FINDINGS"
     extra = ""
+    kp = entry.get("kernel_preflight")
+    if kp is not None:
+        extra += (f", kernel vmem {kp['vmem_bytes'] / 1e6:.2f} MB "
+                  f"({kp['vmem_budget_frac']:.1%} of budget)")
     if "peak_hbm_bytes_per_device" in entry:
         comm = sum(entry["comm_bytes_per_step"].values())
-        extra = (f", comm {comm} B/step, "
-                 f"peak {entry['peak_hbm_bytes_per_device'] / 1e6:.2f} "
-                 f"MB/device")
+        extra += (f", comm {comm} B/step, "
+                  f"peak {entry['peak_hbm_bytes_per_device'] / 1e6:.2f} "
+                  f"MB/device")
     ex = entry.get("execute")
     if ex is not None:
         if "error" in ex:

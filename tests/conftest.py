@@ -31,6 +31,20 @@ import jax  # noqa: E402
 if not TPU_LANE:
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the CPU lane.  The tier-1 suite
+# compiles the SAME tiny-model step programs dozens of times (every parity
+# test builds fresh engines whose HLO is byte-identical); keying compiled
+# executables by HLO hash dedups those within a run and across reruns.
+# Opt out / redirect with JAX_COMPILATION_CACHE_DIR.
+if not TPU_LANE and "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    import tempfile
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+
 import pytest  # noqa: E402
 
 
