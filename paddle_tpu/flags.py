@@ -333,3 +333,38 @@ DEFINE("serving_slo_tpot_ms", 0.0,
        "retired request whose mean time-per-output-token exceeds this "
        "misses SLO, attributed to decode.  0 disables the TPOT "
        "deadline")
+# cost model + perf sentinel (paddle_tpu/observability/costmodel.py,
+# regression.py): per-tick analytical roofline, measured-vs-predicted
+# attribution, and EWMA anomaly/drift detection (BASELINE.md "Cost-model
+# accounting conventions")
+DEFINE("perf_model", "on",
+       "per-tick roofline cost model in ServingEngine: 'on' stamps "
+       "every scheduler tick with predicted_tick_ms (memoized host "
+       "math), records measured/predicted into perf.tick_model_ratio "
+       "histograms labelled by bound, and arms the drift/anomaly "
+       "detectors behind perf_report(); 'off' skips all of it")
+DEFINE("perf_model_profile", "auto",
+       "hardware profile for the roofline: 'auto' picks 'v5e' on a TPU "
+       "backend and 'cpu_smoke' elsewhere; any profile name registered "
+       "in observability.costmodel.PROFILES overrides")
+DEFINE("perf_model_tol", 3.0,
+       "drift band half-width for the measured/predicted ratio EWMA: "
+       "after calibration the per-bound EWMA must stay inside "
+       "[base/(1+tol), base*(1+tol)] or perf_report() carries a "
+       "perf-drift finding (same Finding shape as static_analysis).  "
+       "The default 3.0 (a 4x band around the calibrated baseline) "
+       "absorbs CPU-smoke scheduling noise — clean tier-1 replays sit "
+       "within ~1.5x of calibration but CI machines spike — while a "
+       "sustained slowdown past 4x still trips; TPU runs can tighten it")
+DEFINE("metrics_port", 0,
+       "HTTP exposition port for observability.http_exposition: serve "
+       "/metrics (Prometheus text), /healthz (liveness + anomaly "
+       "status) and /requests (RequestLog JSON tail) on this port.  "
+       "0 (default) disables the server; -1 binds an ephemeral port "
+       "(tests)")
+DEFINE("metrics_max_children", 64,
+       "label-cardinality cap per metric family: past this many "
+       "distinct label sets a family warns once and coalesces further "
+       "new label sets into a single {overflow='true'} child, so "
+       "per-uid or per-shape labels can never grow the registry "
+       "unboundedly")

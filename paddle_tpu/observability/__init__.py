@@ -28,13 +28,21 @@ millisecond histograms carry the ``_ms`` suffix; per-instance series are
 distinguished by labels (``engine="0"``, ``pool="1"``), never by name.
 """
 
+from .costmodel import (CostModel, HardwareProfile, PROFILES,
+                        TickAttribution, kv_bytes_per_token, perf_signature,
+                        resolve_profile)
+from .costmodel import reset as _reset_costmodel
+from .http_exposition import ExpositionServer, maybe_serve
+from . import metrics as _metrics_mod
 from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS_MS,
                       MetricsRegistry, SNAPSHOT_SCHEMA_VERSION,
-                      default_registry, prometheus_text, snapshot)
+                      prometheus_text, snapshot)
 from .metrics import reset as _reset_metrics
+from .regression import EwmaDetector, HISTORY_TOLERANCES, check_history
+from .regression import reset as _reset_regression
 from .request_log import RequestLog, get_request_log
-from .tracing import (SpanTracer, export_chrome_trace, get_tracer, instant,
-                      span)
+from .tracing import (SpanTracer, counter, export_chrome_trace, get_tracer,
+                      instant, span)
 from .watchdog import (RetraceError, RetraceWarning, TrackedFunction,
                        track_retraces)
 
@@ -42,16 +50,35 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "LATENCY_BUCKETS_MS", "SNAPSHOT_SCHEMA_VERSION", "default_registry",
     "snapshot", "prometheus_text", "reset",
-    "SpanTracer", "get_tracer", "span", "instant", "export_chrome_trace",
+    "SpanTracer", "get_tracer", "span", "instant", "counter",
+    "export_chrome_trace",
     "RequestLog", "get_request_log",
     "RetraceError", "RetraceWarning", "TrackedFunction", "track_retraces",
+    "HardwareProfile", "PROFILES", "resolve_profile", "CostModel",
+    "TickAttribution", "kv_bytes_per_token", "perf_signature",
+    "EwmaDetector", "HISTORY_TOLERANCES", "check_history",
+    "ExpositionServer", "maybe_serve",
 ]
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem reports into.
+
+    Delegates through the :mod:`.metrics` module attribute (rather than
+    binding the function at import) so a test that monkeypatches
+    ``metrics.default_registry`` — e.g. the BlockManager model checker
+    handing thousands of short-lived pools throwaway registries —
+    redirects every ``observability.default_registry()`` call site too."""
+    return _metrics_mod.default_registry()
 
 
 def reset() -> None:
     """Clear the default registry AND the default tracer's buffer AND
-    the default request log (test isolation; live metric handles keep
-    working but stop being exported until re-registered)."""
+    the default request log AND every live cost-model/anomaly-detector
+    state (test isolation; live metric handles keep working but stop
+    being exported until re-registered)."""
     _reset_metrics()
     get_tracer().clear()
     get_request_log().clear()
+    _reset_costmodel()
+    _reset_regression()

@@ -1,0 +1,170 @@
+"""Tiny stdlib HTTP exposition server (ISSUE 15 satellite).
+
+Serves the observability layer over HTTP for a scraping/poking
+operator, with zero dependencies beyond ``http.server``:
+
+  * ``/metrics``  — the registry's Prometheus text exposition,
+  * ``/healthz``  — JSON liveness: engine step-trace budgets, perf
+    anomaly totals, drift-finding counts (a load balancer's readiness
+    answer in one GET),
+  * ``/requests`` — the RequestLog's most recent timelines as JSON
+    (``?n=`` caps the tail, default 32 requests).
+
+Off by default: ``FLAGS_metrics_port`` 0 disables it, a positive port
+binds it, and ``-1`` binds an ephemeral port (tests read
+``server.port``).  Lifecycle is a context manager — the daemon thread
+serving requests dies with the ``with`` block, never with the process.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .. import flags as _flags
+from . import metrics as _metrics
+from .request_log import get_request_log
+
+__all__ = ["ExpositionServer", "maybe_serve"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_obs/1"
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        pass                                    # no stderr chatter
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:                   # noqa: N802 (stdlib API)
+        owner: "ExpositionServer" = self.server.owner  # type: ignore
+        url = urlparse(self.path)
+        if url.path == "/metrics":
+            text = owner.registry.prometheus_text()
+            self._send(200, text.encode(), "text/plain; version=0.0.4")
+        elif url.path == "/healthz":
+            body = json.dumps(owner.healthz(), sort_keys=True)
+            self._send(200, body.encode(), "application/json")
+        elif url.path == "/requests":
+            q = parse_qs(url.query)
+            n = int(q.get("n", ["32"])[0])
+            body = json.dumps(owner.request_tail(n), sort_keys=True,
+                              default=str)
+            self._send(200, body.encode(), "application/json")
+        else:
+            self._send(404, b'{"error": "not found"}\n',
+                       "application/json")
+
+
+class ExpositionServer:
+    """Threaded HTTP exposition over the default (or given) registry.
+
+    ``engines`` is an optional list of live ServingEngine instances
+    whose liveness (step-trace budget, drift findings) /healthz folds
+    in; the server holds them weakly-by-convention — it only reads."""
+
+    def __init__(self, port: Optional[int] = None,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 engines: Optional[List[Any]] = None,
+                 host: str = "127.0.0.1") -> None:
+        if port is None:
+            port = int(_flags.flag("metrics_port"))
+        self._requested_port = int(port)
+        self.registry = registry or _metrics.default_registry()
+        self.engines = list(engines or [])
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._requested_port != 0
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves -1/ephemeral after start())."""
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return max(0, self._requested_port)
+
+    # -- payloads ------------------------------------------------------
+
+    def healthz(self) -> Dict[str, Any]:
+        anomalies = 0.0
+        fam = self.registry.get("serving.perf_anomalies")
+        if fam is not None:
+            anomalies = sum(c.value() for c in fam.children())
+        engines = []
+        ok = True
+        for e in self.engines:
+            drift = 0
+            try:
+                drift = len(e.perf_report().get("drift", []))
+            except Exception:
+                pass
+            traces = getattr(e, "step_traces", None)
+            info = {"engine": getattr(e, "_eid", "?"),
+                    "num_slots": getattr(e, "num_slots", None),
+                    "step_traces": traces,
+                    "drift_findings": drift}
+            engines.append(info)
+            # once-jitted contract: >1 step trace is a liveness failure
+            ok = ok and drift == 0 and (traces is None or traces <= 1)
+        return {"ok": bool(ok and anomalies == 0),
+                "perf_anomalies": anomalies,
+                "engines": engines}
+
+    def request_tail(self, n: int = 32) -> Dict[str, Any]:
+        recs = get_request_log().records()
+        uids = sorted(recs)[-max(0, int(n)):]
+        return {"requests": {str(u): recs[u] for u in uids},
+                "total": len(recs)}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ExpositionServer":
+        if not self.enabled or self._httpd is not None:
+            return self
+        port = self._requested_port if self._requested_port > 0 else 0
+        self._httpd = ThreadingHTTPServer((self.host, port), _Handler)
+        self._httpd.owner = self                # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-exposition",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "ExpositionServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def maybe_serve(engines: Optional[List[Any]] = None)\
+        -> Optional[ExpositionServer]:
+    """Start a server iff FLAGS_metrics_port is non-zero; returns the
+    started server or None (the flag's 0 default keeps every test and
+    bench run socket-free unless explicitly opted in)."""
+    srv = ExpositionServer(engines=engines)
+    if not srv.enabled:
+        return None
+    return srv.start()

@@ -31,6 +31,7 @@ import json
 import math
 import re
 import threading
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -190,7 +191,8 @@ _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 class _Family:
     """All children of one metric name (shared kind/help/buckets)."""
 
-    __slots__ = ("name", "kind", "help", "buckets", "_children", "_lock")
+    __slots__ = ("name", "kind", "help", "buckets", "_children", "_lock",
+                 "coalesced", "_overflow_warned")
 
     def __init__(self, name: str, kind: str, help: str,
                  buckets: Optional[Sequence[float]], lock):
@@ -200,12 +202,40 @@ class _Family:
         self.buckets = tuple(buckets) if buckets is not None else None
         self._children: Dict[Tuple, _Child] = {}
         self._lock = lock
+        self.coalesced = 0             # label sets routed to overflow
+        self._overflow_warned = False
 
     def labels(self, **labels: Any) -> _Child:
         key = _label_key(labels)
         with self._lock:
             child = self._children.get(key)
             if child is None:
+                # label-cardinality guard: a family past
+                # FLAGS_metrics_max_children distinct label sets warns
+                # once and coalesces every further NEW label set into a
+                # single {overflow="true"} child, so per-uid/per-shape
+                # labels can never grow the registry unboundedly.
+                # Existing children keep resolving normally.
+                from .. import flags as _flags
+                cap = int(_flags.flag("metrics_max_children"))
+                if cap > 0 and len(self._children) >= cap \
+                        and labels.get("overflow") != "true":
+                    self.coalesced += 1
+                    if not self._overflow_warned:
+                        self._overflow_warned = True
+                        warnings.warn(
+                            f"metric family {self.name!r} hit the "
+                            f"label-cardinality cap ({cap} children); "
+                            f"coalescing new label sets into "
+                            f"{{overflow='true'}} "
+                            f"(FLAGS_metrics_max_children)",
+                            RuntimeWarning, stacklevel=3)
+                    okey = _label_key({"overflow": "true"})
+                    child = self._children.get(okey)
+                    if child is None:
+                        child = _KINDS[self.kind](self, dict(okey))
+                        self._children[okey] = child
+                    return child
                 child = _KINDS[self.kind](self, dict(key))
                 self._children[key] = child
             return child

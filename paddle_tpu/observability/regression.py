@@ -1,0 +1,302 @@
+"""Perf-regression sentinel (ISSUE 15 tentpole c).
+
+Two halves:
+
+  * :class:`EwmaDetector` — a calibrate-then-monitor anomaly detector
+    for runtime perf streams (TTFT / TPOT / tick-time / measured-over-
+    predicted ratio).  The first ``skip`` samples are discarded (jit
+    compiles land in the first measure windows), the next ``warmup``
+    samples average into a baseline, and from then on an EWMA of the
+    stream must stay inside ``[baseline/(1+tol), baseline*(1+tol)]``.
+    Latency streams monitor the upper side only (getting faster is not
+    an anomaly); the cost-model drift detectors run two-sided (a model
+    that suddenly over- or under-predicts is broken either way).
+    Detections feed the ``serving.perf_anomalies{kind=}`` counters via
+    :class:`.costmodel.TickAttribution`.
+
+  * :func:`check_history` — the offline gate behind
+    ``bench.py --check-history``: parse the committed ``BENCH_r*.json``
+    training-bench trajectory and the ``BENCH_DECODE.json`` serving
+    artifact and fail (exit non-zero) when a tracked metric regresses
+    past its committed tolerance in :data:`HISTORY_TOLERANCES`.  This
+    turns the bench artifacts from documentation into a gate: a PR that
+    lands a slower decode row or a fatter int8 streamed-bytes ratio
+    fails CI instead of relying on a reviewer eyeballing the diff.
+
+Thresholds and their provenance are documented in BASELINE.md
+"Cost-model accounting conventions".
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import weakref
+from typing import Any, Dict, List, Optional
+
+__all__ = ["EwmaDetector", "HISTORY_TOLERANCES", "check_history", "reset"]
+
+
+_LIVE: "weakref.WeakSet[EwmaDetector]" = weakref.WeakSet()
+
+
+class EwmaDetector:
+    """Calibrate-then-monitor EWMA threshold detector on one stream."""
+
+    def __init__(self, kind: str, *, tol: float, alpha: float = 0.25,
+                 warmup: int = 8, skip: int = 2,
+                 two_sided: bool = False) -> None:
+        self.kind = str(kind)
+        self.tol = float(tol)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.skip = int(skip)
+        self.two_sided = bool(two_sided)
+        self.reset()
+        _LIVE.add(self)
+
+    def reset(self) -> None:
+        self.seen = 0
+        self.baseline: Optional[float] = None
+        self.ewma: Optional[float] = None
+        self.anomalies = 0
+        self._cal: List[float] = []
+
+    @property
+    def lo(self) -> float:
+        base = self.baseline or 0.0
+        return base / (1.0 + self.tol)
+
+    @property
+    def hi(self) -> float:
+        base = self.baseline or 0.0
+        return base * (1.0 + self.tol)
+
+    def observe(self, v: float) -> bool:
+        """Feed one sample; True when the post-calibration EWMA sits
+        outside the band at this sample."""
+        v = float(v)
+        self.seen += 1
+        if self.seen <= self.skip:
+            return False
+        if self.baseline is None:
+            self._cal.append(v)
+            if len(self._cal) >= self.warmup:
+                self.baseline = sum(self._cal) / len(self._cal)
+                self.ewma = self.baseline
+                self._cal = []
+            return False
+        self.ewma = (1.0 - self.alpha) * self.ewma + self.alpha * v
+        bad = self.ewma > self.hi or (self.two_sided and self.ewma < self.lo)
+        if bad:
+            self.anomalies += 1
+        return bad
+
+    def state(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "seen": self.seen,
+                "baseline": self.baseline, "ewma": self.ewma,
+                "anomalies": self.anomalies, "tol": self.tol,
+                "two_sided": self.two_sided}
+
+
+# -- committed history gate (bench.py --check-history) --------------------
+
+#: Committed tolerances the history gate enforces.  Meanings
+#: (BASELINE.md): *_drop_frac — a tracked higher-is-better metric's
+#: latest committed value may sit at most this fraction below the best
+#: previously committed value; the absolute floors/ceilings restate the
+#: invariants the BENCH sections themselves gate, so a hand-edited (or
+#: regressed re-run) artifact fails here even without re-running the
+#: bench.
+HISTORY_TOLERANCES: Dict[str, float] = {
+    # BENCH_r*.json training-bench trajectory (parsed.value = MFU)
+    "mfu_drop_frac": 0.05,
+    # cpu_plumbing_smoke.int8_serving: int8/full streamed cache bytes
+    # per context token (committed 0.254; the int8 PR gates <= 0.55x)
+    "int8_streamed_ratio_max": 0.55,
+    # cpu_plumbing_smoke.int8_serving capacity at equal pool bytes
+    "int8_capacity_ratio_min": 1.8,
+    # llama_940m_serving.decode: absolute floors restating the
+    # committed rows — head row (b=1, 2048) runs 385.9 tok/s/chip and
+    # the worst row (b=8 paged, 2048) sits at 0.652 of the
+    # weight-stream bound; a regressed re-run (or hand-edit) that lands
+    # below these fails the gate
+    "decode_head_tok_s_floor": 347.0,
+    "decode_of_bound_min": 0.60,
+    # every serving section must keep the once-jitted step contract
+    "step_traces_max": 1.0,
+}
+
+
+def _check(name: str, ok: Optional[bool], detail: str) -> Dict[str, Any]:
+    return {"name": name, "ok": ok, "detail": detail}
+
+
+def _bench_r_trajectory(root: str) -> List[Dict[str, Any]]:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = blob.get("parsed") or {}
+        if "value" in parsed:
+            rows.append({"n": int(m.group(1)),
+                         "metric": parsed.get("metric", ""),
+                         "value": float(parsed["value"])})
+    rows.sort(key=lambda r: r["n"])
+    return rows
+
+
+def check_history(root: Optional[str] = None,
+                  tolerances: Optional[Dict[str, float]] = None)\
+        -> Dict[str, Any]:
+    """Validate the committed bench trajectory under ``root`` (default:
+    the repo root, two levels above this package).  Returns
+    ``{"ok": bool, "checks": [...]}``; a check over a missing artifact
+    reports ``ok: None`` (skipped) rather than failing, so partial
+    checkouts stay green — the committed repo carries every artifact."""
+    tol = dict(HISTORY_TOLERANCES)
+    if tolerances:
+        tol.update(tolerances)
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    checks: List[Dict[str, Any]] = []
+
+    # 1) training-bench MFU trajectory: monotone-ish — the latest run
+    # may not fall more than mfu_drop_frac below the best committed run
+    rows = _bench_r_trajectory(root)
+    if len(rows) >= 2:
+        best = max(r["value"] for r in rows[:-1])
+        last = rows[-1]["value"]
+        floor = best * (1.0 - tol["mfu_drop_frac"])
+        checks.append(_check(
+            "bench_r_mfu_trajectory", last >= floor,
+            f"latest {last:.4f} vs best {best:.4f} "
+            f"(floor {floor:.4f}, n={[r['n'] for r in rows]})"))
+    else:
+        checks.append(_check("bench_r_mfu_trajectory", None,
+                             f"only {len(rows)} BENCH_r rows"))
+
+    # 2) BENCH_DECODE.json invariants
+    decode_path = os.path.join(root, "BENCH_DECODE.json")
+    blob: Dict[str, Any] = {}
+    if os.path.exists(decode_path):
+        try:
+            with open(decode_path) as f:
+                blob = json.load(f)
+        except ValueError as e:
+            checks.append(_check("bench_decode_parse", False, str(e)))
+    if not blob:
+        checks.append(_check("bench_decode_present", None,
+                             "no BENCH_DECODE.json"))
+    cpu = blob.get("cpu_plumbing_smoke", {})
+    int8 = cpu.get("int8_serving", {})
+    sb = int8.get("per_step_streamed_cache_bytes", {})
+    if "ratio" in sb:
+        checks.append(_check(
+            "int8_streamed_bytes_ratio",
+            float(sb["ratio"]) <= tol["int8_streamed_ratio_max"],
+            f"int8/full per-context-token streamed bytes "
+            f"{sb['ratio']} (max {tol['int8_streamed_ratio_max']})"))
+    cap = int8.get("capacity_at_equal_pool_bytes", {})
+    if "capacity_ratio" in cap:
+        checks.append(_check(
+            "int8_capacity_ratio",
+            float(cap["capacity_ratio"]) >= tol["int8_capacity_ratio_min"],
+            f"int8 capacity ratio {cap['capacity_ratio']} "
+            f"(min {tol['int8_capacity_ratio_min']})"))
+    # every committed step_traces count anywhere in the artifact must
+    # honour the once-jitted contract
+    bad_traces: List[str] = []
+
+    def _walk(node: Any, path: str) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                p = f"{path}.{k}" if path else str(k)
+                if k == "step_traces" and isinstance(v, (int, float)):
+                    if v > tol["step_traces_max"]:
+                        bad_traces.append(f"{p}={v}")
+                else:
+                    _walk(v, p)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                _walk(v, f"{path}[{i}]")
+
+    _walk(blob, "")
+    checks.append(_check(
+        "step_traces_budget", not bad_traces if blob else None,
+        "all committed step_traces <= "
+        f"{int(tol['step_traces_max'])}" if not bad_traces
+        else f"over budget: {bad_traces}"))
+    # deterministic-replay booleans committed by serving sections
+    det_flags = []
+
+    def _walk_det(node: Any, path: str) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                p = f"{path}.{k}" if path else str(k)
+                if k.startswith("deterministic") and isinstance(v, bool):
+                    det_flags.append((p, v))
+                else:
+                    _walk_det(v, p)
+
+    _walk_det(blob, "")
+    if det_flags:
+        bad = [p for p, v in det_flags if not v]
+        checks.append(_check(
+            "deterministic_replay", not bad,
+            f"{len(det_flags)} committed determinism flags"
+            + (f"; false: {bad}" if bad else "")))
+    # TPU decode rows: absolute floors restating the committed values
+    dec = blob.get("llama_940m_serving", {}).get("decode")
+    if isinstance(dec, list) and dec:
+        head = dec[0]
+        tps = head.get("tokens_per_sec_per_chip")
+        if tps is not None:
+            checks.append(_check(
+                "decode_head_tok_s",
+                float(tps) >= tol["decode_head_tok_s_floor"],
+                f"head row {tps} tok/s/chip "
+                f"(floor {tol['decode_head_tok_s_floor']})"))
+        bounds = [float(r["of_weight_stream_bound"]) for r in dec
+                  if "of_weight_stream_bound" in r]
+        if bounds:
+            checks.append(_check(
+                "decode_of_weight_stream_bound",
+                min(bounds) >= tol["decode_of_bound_min"],
+                f"worst row {min(bounds)} of the weight-stream bound "
+                f"(floor {tol['decode_of_bound_min']})"))
+    # SLO goodput ordering: chunked admission must not regress below
+    # the wave scheduler on the committed trace
+    slo = cpu.get("slo_serving", {})
+    if "chunked_strictly_better" in slo:
+        checks.append(_check(
+            "slo_chunked_goodput", bool(slo["chunked_strictly_better"]),
+            "chunked goodput strictly better than wave on the "
+            "committed deadline trace"))
+    # perf_model section self-consistency (present once the section ran)
+    pm = cpu.get("perf_model", {})
+    if pm:
+        ok = (pm.get("drift_findings", 1) == 0
+              and pm.get("kv_ratio_consistent", False))
+        checks.append(_check(
+            "perf_model_row", ok,
+            f"drift_findings={pm.get('drift_findings')} "
+            f"kv_ratio_consistent={pm.get('kv_ratio_consistent')}"))
+
+    ok = all(c["ok"] is not False for c in checks)
+    return {"ok": ok, "root": root, "tolerances": tol, "checks": checks}
+
+
+def reset() -> None:
+    """Reset every live detector (observability.reset())."""
+    for det in list(_LIVE):
+        det.reset()

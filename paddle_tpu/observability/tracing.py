@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional
 
 from collections import deque
 
-__all__ = ["SpanTracer", "get_tracer", "span", "instant",
+__all__ = ["SpanTracer", "get_tracer", "span", "instant", "counter",
            "export_chrome_trace"]
 
 
@@ -111,6 +111,21 @@ class SpanTracer:
               "tid": threading.get_ident()}
         if args:
             ev["args"] = args
+        self._append(ev)
+
+    def counter(self, name: str, cat: str = "host",
+                **values: Any) -> None:
+        """Chrome-trace counter sample (ph "C"): one numeric series per
+        kwarg, rendered as stacked counter tracks in Perfetto.  The
+        cost model emits ``serving.tick_model`` predicted/measured
+        samples here every tick, riding next to the ``serving.step``
+        spans."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": "C",
+              "ts": self._now_us(), "pid": self._pid,
+              "tid": threading.get_ident(),
+              "args": {k: float(v) for k, v in values.items()}}
         self._append(ev)
 
     def _append(self, ev: Dict[str, Any]) -> None:
@@ -203,6 +218,10 @@ def span(name: str, cat: str = "host", **args: Any):
 
 def instant(name: str, cat: str = "host", **args: Any) -> None:
     get_tracer().instant(name, cat, **args)
+
+
+def counter(name: str, cat: str = "host", **values: Any) -> None:
+    get_tracer().counter(name, cat, **values)
 
 
 def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:

@@ -594,6 +594,66 @@ class ServingEngine:
                 budget=_PREFILL_TRACE_BUDGET, labels=lbl,
                 **prefill_kwargs)
         self._linted = False           # first-tick self-lint (graph_lint)
+        # per-tick roofline cost model (ISSUE 15): predictions are
+        # memoized host math, so the steady-state tick pays a dict
+        # lookup; FLAGS_perf_model 'off' skips the layer entirely
+        self._perf = (self._build_perf_model()
+                      if _flags.flag("perf_model") == "on" else None)
+
+    # -- cost model / perf attribution (ISSUE 15) --------------------------
+
+    def _build_perf_model(self):
+        """Compose the existing static models into the tick roofline:
+        the params tree's actual bytes (int8 weights shrink the weight-
+        stream term), the pool's dtype-aware per-token KV cost (the
+        committed 0.254x int8 streamed-bytes ratio), and — under a mesh
+        — comm_report's per-step collective bytes, evaluated lazily
+        (one abstract trace) on the first prediction."""
+        from ..observability import costmodel as _cm
+        leaves = jax.tree_util.tree_leaves(self._params)
+        weight_bytes = int(sum(leaf.nbytes for leaf in leaves))
+        n_params = int(sum(leaf.size for leaf in leaves))
+        # int8 scale amortization granule: the paged pool keeps one
+        # scale row per block, the contiguous pool one per 128-token
+        # granule (models/generation.init_kv_cache)
+        kv_tok = _cm.kv_bytes_per_token(
+            self.config, self.kv_dtype,
+            block_len=self.block_len if self.paged else 128)
+        comm_fn = None
+        if self.mesh is not None:
+            def comm_fn():
+                comm = self.mesh_preflight()["comm"]
+                return int(comm.get("total_bytes_per_step", 0))
+        model = _cm.CostModel(
+            _cm.resolve_profile(), weight_bytes=weight_bytes,
+            n_params=n_params, kv_token_bytes=kv_tok,
+            num_slots=self.num_slots, comm_bytes_fn=comm_fn)
+        return _cm.TickAttribution(model, engine_id=self._eid)
+
+    def _perf_tick(self, measured_ms: float, occ: int,
+                   chunk_tokens: int = 0) -> None:
+        """Stamp one measured tick with the model's prediction at the
+        tick's ACTUAL occupancy / live depths / chunk state (positions
+        are still pre-advance here — the depths the step just read)."""
+        if self._perf is None:
+            return
+        live = int(self._positions[self._active].sum()) if occ else 0
+        self._perf.on_tick(
+            measured_ms, occ=occ, live_tokens=live,
+            chunk_tokens=chunk_tokens,
+            window=self.spec_k + 1 if self.spec else 1)
+
+    def perf_report(self) -> Dict[str, object]:
+        """Predicted-vs-measured attribution for this engine: per-bound
+        tick shares, per-term predicted totals, measured/predicted
+        ratio percentiles, drift findings (static_analysis Finding
+        shape) and anomaly counts.  The predicted side is a pure
+        function of the deterministic schedule — loadgen's smoke gate
+        checks it byte-stable across replays via
+        observability.perf_signature."""
+        if self._perf is None:
+            return {"enabled": False}
+        return dict(self._perf.report(), enabled=True)
 
     # -- mesh execution (ISSUE 9) ------------------------------------------
 
@@ -1220,6 +1280,7 @@ class ServingEngine:
             nxt = np.asarray(nxt)        # the tick's one host sync
         now = time.perf_counter()
         self._m_step_ms.observe((now - t0) * 1e3)
+        self._perf_tick((now - t0) * 1e3, occ)
         finished.extend(self._advance_decode(nxt, now))
         return finished
 
@@ -1319,6 +1380,7 @@ class ServingEngine:
             out, n_acc = jax.device_get((out, n_acc))  # the one host sync
         now = time.perf_counter()
         self._m_step_ms.observe((now - t0) * 1e3)
+        self._perf_tick((now - t0) * 1e3, occ)
         finished.extend(self._advance_decode_spec(
             np.asarray(out), np.asarray(n_acc), draft_ok, now))
         return finished
@@ -1495,6 +1557,8 @@ class ServingEngine:
                 nxt, ctok = jax.device_get((nxt, ctok))  # the one sync
         now = time.perf_counter()
         self._m_step_ms.observe((now - t0) * 1e3)
+        self._perf_tick((now - t0) * 1e3, occ,
+                        chunk_tokens=clen if do_chunk else 0)
         if self.spec:
             finished.extend(self._advance_decode_spec(
                 np.asarray(out), np.asarray(n_acc), draft_ok, now))
@@ -1585,6 +1649,8 @@ class ServingEngine:
         self._results[req.request_id].append(ctok)
         self._m_tokens.inc()
         self._m_ttft.observe((now - req.t_submit) * 1e3)
+        if self._perf is not None:
+            self._perf.on_ttft((now - req.t_submit) * 1e3)
         self._rlog.event(req.uid, "first_token", engine=self._eid,
                          ttft_ms=(now - req.t_submit) * 1e3)
         reason = self._finish_reason(ctok, slot, si)
@@ -2081,6 +2147,8 @@ class ServingEngine:
         if n > 1 and slot.t_first > 0.0:
             tpot = (now - slot.t_first) * 1e3 / (n - 1)
             self._m_tpot.observe(tpot)
+            if self._perf is not None:
+                self._perf.on_tpot(tpot)
         self._m_finished.inc()
         self._f_retired.labels(engine=self._eid, reason=reason).inc()
         req = slot.req
@@ -2253,6 +2321,8 @@ class ServingEngine:
             self._results[req.request_id].append(int(tok[r]))
             self._m_tokens.inc()
             self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
+            if self._perf is not None:
+                self._perf.on_ttft((t_tok - req.t_submit) * 1e3)
             self._rlog.event(req.uid, "first_token", engine=self._eid,
                              ttft_ms=(t_tok - req.t_submit) * 1e3)
             reason = self._finish_reason(int(tok[r]), slot, si)
@@ -2318,6 +2388,8 @@ class ServingEngine:
             self._results[req.request_id].append(int(tok[r]))
             self._m_tokens.inc()
             self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
+            if self._perf is not None:
+                self._perf.on_ttft((t_tok - req.t_submit) * 1e3)
             self._rlog.event(req.uid, "first_token", engine=self._eid,
                              ttft_ms=(t_tok - req.t_submit) * 1e3)
             reason = self._finish_reason(int(tok[r]), slot, si)

@@ -217,6 +217,11 @@ def replay(target, load: Sequence[LoadRequest],
                               wall_s=wall),
         "signature": log.timeline_signature(since_uid=mark,
                                             until_uid=end_mark),
+        # predicted-vs-measured attribution per engine (ISSUE 15); the
+        # predicted side is schedule-deterministic — _smoke gates its
+        # perf_signature byte-stable across the A/B replays
+        "perf": [e.perf_report() for e in engines
+                 if hasattr(e, "perf_report")],
         # the (mark, end_mark] bracket scopes any post-hoc RequestLog
         # readout — slo_report with explicit targets, Perfetto export —
         # to exactly this run
@@ -290,12 +295,37 @@ def _smoke() -> int:
         if a["outputs"] != b["outputs"]:
             failures.append(f"{mode}: sampled-output drift between "
                             f"identical-seed runs")
+        # ISSUE 15 gates: the cost-model report must be clean (no drift
+        # findings, no perf anomalies) on the deterministic CPU traces,
+        # and its predicted side byte-stable across the A/B replays
+        perf_sigs = []
+        drift_findings = 0
+        anomalies = 0
+        for r in (a, b):
+            for rep in r.get("perf", []):
+                if not rep.get("enabled", False):
+                    continue
+                perf_sigs.append(_obs.perf_signature(rep))
+                drift_findings += len(rep.get("drift", []))
+                anomalies += sum(rep.get("anomalies", {}).values())
+        if drift_findings:
+            failures.append(f"{mode}: {drift_findings} cost-model drift "
+                            f"finding(s) on a deterministic CPU trace")
+        if anomalies:
+            failures.append(f"{mode}: {anomalies} serving.perf_anomalies "
+                            f"detection(s) on a deterministic CPU trace")
+        if len(set(perf_sigs)) > 1:
+            failures.append(f"{mode}: perf_report predicted-side drift "
+                            f"between identical-seed runs")
         summary[mode] = {
             "ticks": a["ticks"],
             "generated_tokens": a["generated_tokens"],
             "step_traces": traces,
             "goodput": a["slo"]["goodput"],
             "kernel_findings": kernel_findings,
+            "perf_drift_findings": drift_findings,
+            "perf_anomalies": anomalies,
+            "perf_deterministic": len(set(perf_sigs)) <= 1,
             "deterministic": (a["signature"] == b["signature"]
                               and a["outputs"] == b["outputs"])}
     summary["failures"] = failures

@@ -65,9 +65,9 @@ from .core import (Finding, GraphLintError, GraphLintWarning,
                    trace_for_mesh_lint)
 from .kernel_registry import (KernelSpec, KernelSpecError,
                               decode_attention_spec, flash_attention_spec,
-                              int8_matmul_spec, rms_norm_spec,
-                              registered_kernel_specs, streamed_bytes,
-                              vmem_footprint)
+                              int8_matmul_spec, kv_streamed_bytes,
+                              rms_norm_spec, registered_kernel_specs,
+                              streamed_bytes, vmem_footprint)
 from .kernel_rules import (KernelRule, KernelVmemRule, KernelBoundsRule,
                            KernelAlignRule, KernelScaleGranuleRule,
                            KernelStreamRule, analyze_kernels,
@@ -93,6 +93,7 @@ __all__ = [
     "KernelSpec", "KernelSpecError", "decode_attention_spec",
     "flash_attention_spec", "int8_matmul_spec", "rms_norm_spec",
     "registered_kernel_specs", "vmem_footprint", "streamed_bytes",
+    "kv_streamed_bytes",
     "KernelRule", "KernelVmemRule", "KernelBoundsRule",
     "KernelAlignRule", "KernelScaleGranuleRule", "KernelStreamRule",
     "default_kernel_rules", "analyze_kernels", "kernel_report",

@@ -1,0 +1,114 @@
+"""HTTP exposition server (paddle_tpu/observability/http_exposition).
+
+Real in-process GETs over an ephemeral loopback port: /metrics serves
+the registry's Prometheus text byte-for-byte, /healthz folds engine
+drift + anomaly counters into one readiness answer, /requests tails the
+request log, unknown paths 404.  The FLAGS_metrics_port=0 default keeps
+everything socket-free; ``maybe_serve`` honours the flag.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+from paddle_tpu import flags
+from paddle_tpu.observability.http_exposition import (ExpositionServer,
+                                                      maybe_serve)
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_disabled_by_default_and_off_at_port_zero():
+    assert flags.flag("metrics_port") == 0
+    assert maybe_serve() is None            # the default: no socket
+    srv = ExpositionServer(port=0)
+    assert not srv.enabled
+    assert srv.start() is srv               # no-op, still unbound
+    assert srv.port == 0
+
+
+def test_metrics_healthz_requests_and_404_over_http():
+    reg = MetricsRegistry()
+    reg.counter("t.hits", "exposition smoke").labels(op="a").inc(3)
+    with ExpositionServer(port=-1, registry=reg) as srv:
+        assert srv.port > 0                 # ephemeral port resolved
+
+        code, ctype, body = _get(srv.port, "/metrics")
+        assert code == 200
+        assert ctype.startswith("text/plain")
+        assert body.decode() == reg.prometheus_text()
+
+        code, ctype, body = _get(srv.port, "/healthz")
+        assert code == 200 and ctype.startswith("application/json")
+        h = json.loads(body)
+        assert h["ok"] is True
+        assert h["perf_anomalies"] == 0
+        assert h["engines"] == []
+
+        code, _, body = _get(srv.port, "/requests?n=4")
+        tail = json.loads(body)
+        assert set(tail) == {"requests", "total"}
+
+        try:
+            _get(srv.port, "/no/such/path")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.loads(e.read()) == {"error": "not found"}
+        else:  # pragma: no cover
+            raise AssertionError("expected a 404")
+    # the context manager tore the socket down
+    try:
+        _get(srv.port, "/healthz")
+    except (urllib.error.URLError, ConnectionError, OSError):
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("server still answering after __exit__")
+
+
+class _DriftyEngine:
+    _eid = "9"
+    num_slots = 2
+    step_traces = 1
+
+    def perf_report(self):
+        return {"drift": [{"rule": "perf-drift"}]}
+
+
+class _RetracedEngine:
+    _eid = "7"
+    num_slots = 2
+    step_traces = 3                        # blown once-jitted budget
+
+    def perf_report(self):
+        return {"drift": []}
+
+
+def test_healthz_folds_in_engine_drift_and_retraces():
+    with ExpositionServer(port=-1, registry=MetricsRegistry(),
+                          engines=[_DriftyEngine()]) as srv:
+        h = json.loads(_get(srv.port, "/healthz")[2])
+        assert h["ok"] is False
+        assert h["engines"] == [{"engine": "9", "num_slots": 2,
+                                 "step_traces": 1, "drift_findings": 1}]
+    with ExpositionServer(port=-1, registry=MetricsRegistry(),
+                          engines=[_RetracedEngine()]) as srv:
+        h = json.loads(_get(srv.port, "/healthz")[2])
+        assert h["ok"] is False
+        assert h["engines"][0]["step_traces"] == 3
+
+
+def test_maybe_serve_honours_the_flag():
+    old = flags.flag("metrics_port")
+    flags.set_flags({"metrics_port": -1})
+    try:
+        srv = maybe_serve()
+        assert srv is not None
+        assert _get(srv.port, "/healthz")[0] == 200
+        srv.stop()
+    finally:
+        flags.set_flags({"metrics_port": old})

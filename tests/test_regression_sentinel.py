@@ -1,0 +1,174 @@
+"""Perf-regression sentinel (paddle_tpu/observability/regression).
+
+Two halves under test: the calibrate-then-monitor EwmaDetector (skip /
+warmup semantics, one-sided vs two-sided bands, anomaly counting,
+reset) and the ``bench.py --check-history`` offline gate — green on the
+committed artifacts, red on synthetically-regressed copies (the ISSUE
+15 acceptance unit test), and the CLI exit-code mapping.
+"""
+
+import glob
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+from paddle_tpu.observability.regression import (EwmaDetector,
+                                                 HISTORY_TOLERANCES,
+                                                 check_history)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- EwmaDetector ------------------------------------------------------------
+
+def test_skip_then_calibrate_then_monitor():
+    d = EwmaDetector("t", tol=1.0, warmup=4, skip=2)
+    # the first ``skip`` samples (compile spikes) never reach the
+    # calibration window — a 1000x outlier leaves no trace
+    assert not d.observe(1000.0)
+    assert not d.observe(500.0)
+    for v in (1.0, 1.1, 0.9, 1.0):
+        assert not d.observe(v)            # calibration, never anomalous
+    assert d.baseline == pytest.approx(1.0)
+    assert d.lo == pytest.approx(0.5)
+    assert d.hi == pytest.approx(2.0)
+    assert not d.observe(1.3)              # in band
+    assert d.anomalies == 0
+
+
+def test_one_sided_ignores_speedups_catches_slowdowns():
+    d = EwmaDetector("lat", tol=1.0, alpha=0.5, warmup=4, skip=0)
+    for _ in range(4):
+        d.observe(10.0)
+    for _ in range(10):
+        assert not d.observe(0.01)         # getting faster: not anomalous
+    assert d.anomalies == 0
+    fired = [d.observe(100.0) for _ in range(6)]
+    assert any(fired)
+    assert d.anomalies == sum(fired)
+    assert d.state()["baseline"] == pytest.approx(10.0)
+
+
+def test_two_sided_catches_underprediction_and_reset():
+    d = EwmaDetector("ratio", tol=1.0, alpha=0.5, warmup=4, skip=0,
+                     two_sided=True)
+    for _ in range(4):
+        d.observe(8.0)
+    fired = False
+    for _ in range(8):
+        fired = d.observe(0.01) or fired   # EWMA sinks below lo = 4.0
+    assert fired and d.anomalies >= 1
+    d.reset()
+    assert d.seen == 0 and d.anomalies == 0
+    assert d.baseline is None and d.ewma is None
+
+
+# -- committed-history gate --------------------------------------------------
+
+def test_check_history_green_on_committed_repo():
+    r = check_history()
+    assert r["ok"] is True
+    assert r["root"] == REPO
+    names = {c["name"] for c in r["checks"]}
+    assert {"bench_r_mfu_trajectory", "int8_streamed_bytes_ratio",
+            "step_traces_budget", "decode_head_tok_s",
+            "perf_model_row"} <= names
+    assert all(c["ok"] is not False for c in r["checks"])
+
+
+def _copy_artifacts(tmp):
+    for f in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        shutil.copy(f, tmp)
+    shutil.copy(os.path.join(REPO, "BENCH_DECODE.json"), tmp)
+    return str(tmp)
+
+
+def _edit(path, fn):
+    with open(path) as f:
+        blob = json.load(f)
+    fn(blob)
+    with open(path, "w") as f:
+        json.dump(blob, f)
+
+
+def test_synthetic_mfu_regression_fails(tmp_path):
+    root = _copy_artifacts(tmp_path)
+    latest = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))[-1]
+    _edit(latest, lambda b: b["parsed"].update(
+        value=b["parsed"]["value"] * 0.5))
+    r = check_history(root)
+    assert r["ok"] is False
+    bad = {c["name"]: c["ok"] for c in r["checks"]}
+    assert bad["bench_r_mfu_trajectory"] is False
+
+
+def test_synthetic_int8_ratio_regression_fails(tmp_path):
+    root = _copy_artifacts(tmp_path)
+
+    def fatten(b):
+        b["cpu_plumbing_smoke"]["int8_serving"][
+            "per_step_streamed_cache_bytes"]["ratio"] = 0.9
+
+    _edit(os.path.join(root, "BENCH_DECODE.json"), fatten)
+    r = check_history(root)
+    assert r["ok"] is False
+    bad = {c["name"]: c["ok"] for c in r["checks"]}
+    assert bad["int8_streamed_bytes_ratio"] is False
+
+
+def test_synthetic_retrace_regression_fails(tmp_path):
+    root = _copy_artifacts(tmp_path)
+
+    def retrace(b):
+        b["cpu_plumbing_smoke"]["serving"]["step_traces"] = 3
+
+    _edit(os.path.join(root, "BENCH_DECODE.json"), retrace)
+    r = check_history(root)
+    assert r["ok"] is False
+    bad = {c["name"]: c["ok"] for c in r["checks"]}
+    assert bad["step_traces_budget"] is False
+
+
+def test_missing_artifacts_skip_rather_than_fail(tmp_path):
+    r = check_history(str(tmp_path))
+    assert r["ok"] is True                  # partial checkouts stay green
+    assert any(c["ok"] is None for c in r["checks"])
+
+
+def test_tolerance_overrides_apply():
+    r = check_history(tolerances={"decode_head_tok_s_floor": 1e9})
+    assert r["ok"] is False
+    bad = {c["name"]: c["ok"] for c in r["checks"]}
+    assert bad["decode_head_tok_s"] is False
+    # the committed defaults are untouched
+    assert HISTORY_TOLERANCES["decode_head_tok_s_floor"] == 347.0
+
+
+# -- CLI exit mapping --------------------------------------------------------
+
+def test_bench_check_history_cli_exit_codes(monkeypatch, capsys):
+    """``bench.py --check-history`` exits 0 on the committed trajectory
+    and non-zero once a tracked metric regresses past tolerance."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--check-history"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 0
+    assert json.loads(capsys.readouterr().out)["ok"] is True
+
+    # regress a committed floor past the committed value: same CLI,
+    # same artifacts, non-zero exit
+    from paddle_tpu.observability import regression
+    monkeypatch.setitem(regression.HISTORY_TOLERANCES,
+                        "decode_head_tok_s_floor", 1e9)
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1
+    assert json.loads(capsys.readouterr().out)["ok"] is False
