@@ -1738,6 +1738,275 @@ def _perf_model_bench(model, on_tpu):
                      "675 GB/s profile"}}
 
 
+def _preempt_serving_bench(model, on_tpu):
+    """Preemptive scheduling + tiered KV cache A/B/C (ISSUE 16): the
+    SAME seeded heavy-tail loadgen trace replayed under a POOL TOO
+    TIGHT for the working set through three paged engines —
+    FIFO-blocking (``preempt="off"``: admission waits for a running
+    request to retire), preempt+swap (victim blocks copied to the
+    pinned host pool, resumed by swap-in), and preempt+recompute
+    (victim blocks freed, resumed by re-prefill through the prefix
+    trie).  The trace carries two priority classes: the minority
+    tenant is INTERACTIVE (priority 5, a tight TTFT deadline stamped
+    at submit), the majority tenant is BATCH (priority 0, TPOT-only —
+    a throughput class doesn't die of queueing).  Deadlines are
+    self-calibrated from the swap engine's own measured pass (per-
+    class p99 x 1.5) and stamped identically for all three engines,
+    then each engine's judged pass is joined against the RECORDED
+    per-request deadlines — so the ruler is one fixed pair of
+    class-SLOs, not per-engine flags.  The FIFO engine must park
+    interactive arrivals behind batch residents (admission_wait blows
+    their TTFT); both preemptive engines evict a batch victim instead
+    and must win goodput STRICTLY, while serving GREEDY
+    TOKEN-IDENTICAL outputs for every request (preempted ones
+    included).  Also banked: preemption/swap counters for the judged
+    pass, the victim-decision signature replaying byte-identical on a
+    twin engine, and the resident-session capacity row — peak
+    in-flight sessions (active + swapped-out awaiting resume) at
+    EQUAL HBM pool bytes, the host tier's capacity multiplier
+    (BASELINE.md 'Preemption accounting conventions')."""
+    import numpy as np
+
+    from paddle_tpu import flags as _fl
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import LoadSpec, ServingEngine, generate_load
+
+    if on_tpu:
+        slots, max_len, bl, n_req = 8, 2048, 128, 32
+        nb, hostb = 24, 64
+        buckets, out_med, out_lo, out_hi = (32, 64, 1024), 48.0, 16, 96
+    else:  # plumbing smoke: tiny trace, no perf meaning
+        slots, max_len, bl, n_req = 4, 256, 16, 24
+        nb, hostb = 16, 48
+        buckets, out_med, out_lo, out_hi = (8, 16, 192), 14.0, 8, 24
+    seed = 11
+    # zipf a=1.0 over the buckets gives the top bucket real mass: a
+    # near-pool-sized resident whose block footprint starves admission
+    spec = LoadSpec(
+        n_requests=n_req, vocab=model.config.vocab_size,
+        arrival="poisson", mean_gap=1.0,
+        prompt_dist="zipf", prompt_buckets=buckets, prompt_zipf_a=1.0,
+        prompt_max=max(buckets),
+        output_dist="lognormal", output_median=out_med, output_sigma=0.5,
+        output_min=out_lo, output_max=out_hi,
+        tenants=2, shared_prefix_len=4)
+    load = generate_load(spec, seed=seed)
+    order = sorted(range(len(load)),
+                   key=lambda i: (load[i].arrival, load[i].index))
+    # tenant 1 is the zipf-minority: the interactive class
+    hi = [r.tenant == 1 for r in load]
+    log = obs.get_request_log()
+    slo_keys = ("serving_slo_ttft_ms", "serving_slo_tpot_ms")
+    slo_saved = _fl.get_flags(slo_keys)
+
+    def drive(eng, deadlines=None):
+        """loadgen.replay's exact tick schedule, submitting each
+        request with its class priority and (judged passes) the
+        class-SLO stamp, plus a per-tick sample of in-flight sessions
+        (active + swapped-out awaiting resume) for the capacity row."""
+        mark = log.mark()
+        tick = nxt = peak = 0
+        rids, t0 = {}, time.perf_counter()
+        while (nxt < len(order) or eng.queue_depth or eng.num_active
+               or eng.num_pending or eng.num_preempted):
+            while nxt < len(order) and load[order[nxt]].arrival <= tick:
+                i = order[nxt]
+                r = load[i]
+                if deadlines is not None:
+                    t_ttft, t_tpot = deadlines
+                    _fl.set_flags({
+                        # batch TTFT unbounded: a throughput class
+                        "serving_slo_ttft_ms": t_ttft if hi[i] else 0.0,
+                        "serving_slo_tpot_ms": t_tpot})
+                try:
+                    rids[i] = eng.submit(r.prompt, priority=5 if hi[i]
+                                         else 0,
+                                         max_new_tokens=r.max_new_tokens)
+                except ValueError:
+                    pass
+                nxt += 1
+            eng.step()
+            peak = max(peak, eng.num_active + eng.num_preempted)
+            tick += 1
+        wall = time.perf_counter() - t0
+        end_mark = log.mark()
+        outputs = [eng.result(rids[i]) if i in rids else None
+                   for i in range(len(load))]
+        return {"mark": mark, "end_mark": end_mark, "wall_s": wall,
+                "ticks": tick, "peak": peak, "outputs": outputs,
+                "generated_tokens": sum(len(o) for o in outputs if o),
+                "uids": {i: eng.request_uid(r) for i, r in rids.items()},
+                "signature": log.timeline_signature(
+                    since_uid=mark, until_uid=end_mark)}
+
+    def build(**kw):
+        return ServingEngine(model, num_slots=slots, max_length=max_len,
+                             paged=True, block_len=bl, num_blocks=nb,
+                             **kw)
+
+    def _retired_lat(rep):
+        """(interactive ttft_ms, all tpot_ms) lists for a pass."""
+        recs = log.records(rep["mark"], rep["end_mark"])
+        uid_hi = {rep["uids"][i] for i in rep["uids"] if hi[i]}
+        ttfts, tpots = [], []
+        for uid, evs in recs.items():
+            ret = next((e["attrs"] for e in evs
+                        if e["name"] == "retired"), None)
+            if not ret or ret.get("reason") == "cancelled":
+                continue
+            if uid in uid_hi and ret.get("ttft_ms") is not None:
+                ttfts.append(float(ret["ttft_ms"]))
+            if ret.get("tpot_ms") is not None:
+                tpots.append(float(ret["tpot_ms"]))
+        return ttfts, tpots
+
+    try:
+        # -- calibration: swap engine, warm pass then measured pass ----
+        e_sw = build(preempt="swap", host_blocks=hostb)
+        drive(e_sw)                           # A: compile + warm
+        cal = drive(e_sw)                     # B: steady-state calibrate
+        ttfts, tpots = _retired_lat(cal)
+        t_ttft = round(float(np.percentile(ttfts, 99)) * 1.5, 3)
+        t_tpot = round(float(np.percentile(tpots, 99)) * 1.5, 3)
+        dl = (t_ttft, t_tpot)
+
+        # -- judged passes: same stamp, same trace, three engines ------
+        sw_pre = e_sw.metrics()
+        sw_b = drive(e_sw, deadlines=dl)      # C: judged
+        sw_sig = e_sw.preempt_signature()     # decision log through C
+
+        e_off = build(preempt="off")
+        drive(e_off)
+        off_b = drive(e_off, deadlines=dl)
+
+        e_rc = build(preempt="recompute")
+        drive(e_rc)
+        rc_pre = e_rc.metrics()
+        rc_b = drive(e_rc, deadlines=dl)
+
+        # twin engine, identical pass sequence (warm, calibrate,
+        # judged): its judged-pass timeline and outputs must reproduce
+        # e_sw's exactly, and the victim decisions (tick, victim,
+        # waiter, mode, slot, progress) must hash byte-identical — the
+        # determinism contract the saturated smoke also gates.  A
+        # SAME-engine re-replay would not do: under a tight pool the
+        # prefix trie's LRU carryover differs at each pass boundary.
+        twin = build(preempt="swap", host_blocks=hostb)
+        drive(twin)
+        drive(twin)
+        sw_c = drive(twin, deadlines=dl)
+        sig_stable = twin.preempt_signature() == sw_sig
+    finally:
+        _fl.set_flags(slo_saved)
+
+    def judge(eng, rep, pre):
+        # no explicit targets: the join runs against the per-request
+        # deadlines recorded at submit — the class-SLO stamp
+        slo = log.slo_report(since_uid=rep["mark"],
+                             until_uid=rep["end_mark"],
+                             wall_s=rep["wall_s"])
+        m = eng.metrics()
+        row = {"goodput": slo["goodput"],
+               "goodput_tok_s": slo["goodput_tok_s"],
+               "attained": slo["attained"],
+               "violations": slo["violations"],
+               "ttft_ms": slo["ttft_ms"], "tpot_ms": slo["tpot_ms"],
+               "interactive_ttft_ms": (lambda xs: {
+                   "count": len(xs),
+                   "max": round(max(xs, default=0.0), 3)})(
+                       _retired_lat(rep)[0]),
+               "generated_tokens": rep["generated_tokens"],
+               "ticks": rep["ticks"],
+               "step_traces": int(eng.step_traces),
+               "lint_findings": len(eng.lint_step())}
+        if pre is not None:                    # judged-pass deltas
+            row["preemptions"] = (
+                sum(m["preempt"]["preemptions"].values())
+                - sum(pre["preempt"]["preemptions"].values()))
+            row["resumes"] = (
+                sum(m["preempt"]["resumes"].values())
+                - sum(pre["preempt"]["resumes"].values()))
+        return row
+
+    off_row = judge(e_off, off_b, None)
+    sw_row = judge(e_sw, sw_b, sw_pre)
+    rc_row = judge(e_rc, rc_b, rc_pre)
+    ht, ht0 = (e_sw.metrics()["kv_cache"]["host_tier"],
+               sw_pre["kv_cache"]["host_tier"])
+    sw_row["swap"] = {
+        k: ht[k] - ht0[k]
+        for k in ("swapped_out_blocks", "swapped_in_blocks",
+                  "swap_out_bytes", "swap_in_bytes",
+                  "host_demotions", "host_promotions")}
+    perf = e_sw.perf_report()
+    if perf.get("enabled"):
+        sw_row["predicted_swap_ms"] = round(
+            perf["predicted_ms"].get("swap_ms", 0.0), 4)
+
+    identical = (off_b["outputs"] == sw_b["outputs"] == rc_b["outputs"])
+    deterministic = (sw_c["signature"] == sw_b["signature"]
+                     and sw_c["outputs"] == sw_b["outputs"])
+    better = (sw_row["goodput"] > off_row["goodput"]
+              and rc_row["goodput"] > off_row["goodput"])
+    peak_off, peak_sw, peak_rc = (off_b["peak"], sw_b["peak"],
+                                  rc_b["peak"])
+    return {
+        "num_slots": slots, "max_length": max_len, "block_len": bl,
+        "requests": n_req,
+        "pool": {"hbm_blocks": nb, "host_blocks": hostb,
+                 "note": "tight by design — the top prompt bucket's "
+                         "block footprint is most of the pool"},
+        "load": {"arrival": "poisson, mean gap 1.0 ticks",
+                 "prompt_mix": f"zipf-bucketed {list(buckets)} a=1.0",
+                 "output_mix": f"lognormal median {out_med} "
+                               f"clamp [{out_lo},{out_hi}]",
+                 "tenants": 2, "shared_prefix_len": 4, "seed": seed,
+                 "interactive_requests": sum(hi),
+                 "classes": "tenant 1 = interactive (priority 5, "
+                            "TTFT+TPOT SLO); tenant 0 = batch "
+                            "(priority 0, TPOT-only)"},
+        "slo_targets_ms": {"interactive_ttft_p99": t_ttft,
+                           "tpot_p99": t_tpot,
+                           "rule": "swap engine measured pass, per-"
+                                   "class p99 x 1.5, stamped at submit "
+                                   "for all three engines"},
+        "fifo_blocking": off_row,
+        "preempt_swap": sw_row,
+        "preempt_recompute": rc_row,
+        "preempt_goodput_strictly_better": bool(better),
+        "outputs_token_identical": bool(identical),
+        "resident_capacity_at_equal_hbm_bytes": {
+            "hbm_pool_bytes": e_off.cache_hbm_bytes,
+            "peak_in_flight_sessions": {
+                "fifo_blocking": peak_off,
+                "preempt_swap": peak_sw,
+                "preempt_recompute": peak_rc},
+            "capacity_ratio_swap_over_fifo": round(
+                peak_sw / max(1, peak_off), 3),
+            "swap_holds_more_sessions": peak_sw > peak_off,
+            "note": "in-flight = active slots + swapped-out awaiting "
+                    "resume; all three engines hold the SAME HBM pool "
+                    "— the swap tier's extra sessions live in host RAM"},
+        "preempt_signature_stable": bool(sig_stable),
+        "deterministic_replay": bool(deterministic),
+        "note": "same seeded load, same tight pool, one class-SLO "
+                "stamp (swap engine: warm, calibrate, judged passes; "
+                "a twin swap engine replays the identical sequence "
+                "for the determinism gates; the others: warm + "
+                "judged); goodput counts ALL submitted requests, "
+                "preempted-then-finished included; swap bytes never "
+                "count as streamed KV bytes (BASELINE.md 'Preemption "
+                "accounting conventions')",
+        "tpu_recheck": None if on_tpu else {
+            "status": "pending_tpu",
+            "command": "bench.py --sections preempt_serving",
+            "claim": "on v5e the swap path's host copies ride the "
+                     "16 GB/s PCIe term while decode stays HBM-bound, "
+                     "so preempt+swap holds its goodput edge over "
+                     "recompute as contexts grow past the re-prefill "
+                     "break-even"}}
+
+
 def _merge_decode_artifact(section_key, section):
     """Incremental write: each finished section lands on disk immediately,
     so a wedged later section (tunnel RPC hangs are real — round 5) never
@@ -1801,7 +2070,7 @@ def run_decode_bench(args):
     n = pbytes = 0
     if want & {"prefill", "decode", "int8", "e2e", "serving",
                "spec_decode", "mesh_serving", "slo_serving",
-               "int8_serving", "perf_model"}:
+               "int8_serving", "perf_model", "preempt_serving"}:
         model, params, n = _decode_model(max_pos=8192 if on_tpu else 512,
                                          on_tpu=on_tpu)
         pbytes = n * 2                                  # bf16 weights
@@ -2023,6 +2292,21 @@ def run_decode_bench(args):
               f"{pm['drift_findings']}, step_traces {pm['step_traces']}",
               file=sys.stderr)
 
+    # -- preemptive scheduling + tiered KV cache A/B/C -------------------
+    if "preempt_serving" in want:
+        print("[decode-bench] preempt serving A/B/C ...", file=sys.stderr)
+        ps = _preempt_serving_bench(model, on_tpu)
+        _merge_decode_artifact(skey, {"preempt_serving": ps})
+        cap = ps["resident_capacity_at_equal_hbm_bytes"]
+        print(f"preempt_serving: goodput fifo "
+              f"{ps['fifo_blocking']['goodput']} vs swap "
+              f"{ps['preempt_swap']['goodput']} vs recompute "
+              f"{ps['preempt_recompute']['goodput']} (strictly better "
+              f"{ps['preempt_goodput_strictly_better']}), token-identical "
+              f"{ps['outputs_token_identical']}, peak sessions "
+              f"{cap['peak_in_flight_sessions']}, decision signature "
+              f"stable {ps['preempt_signature_stable']}", file=sys.stderr)
+
     # -- mesh-sharded serving: mp engine + dp router A/B -----------------
     if "mesh_serving" in want:
         print("[decode-bench] mesh serving A/B ...", file=sys.stderr)
@@ -2179,7 +2463,10 @@ def main():
                          "the 'slo_serving' goodput-under-SLO wave-vs-"
                          "chunked A/B on one seeded loadgen trace and "
                          "the 'perf_model' roofline attribution A/B "
-                         "(bf16 vs int8 KV on one trace); "
+                         "(bf16 vs int8 KV on one trace) and the "
+                         "'preempt_serving' preemption + tiered-KV A/B/C "
+                         "(FIFO-blocking vs preempt+swap vs "
+                         "preempt+recompute under a tight pool); "
                          "implies --decode")
     ap.add_argument("--check-history", action="store_true",
                     dest="check_history",

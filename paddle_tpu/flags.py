@@ -333,6 +333,30 @@ DEFINE("serving_slo_tpot_ms", 0.0,
        "retired request whose mean time-per-output-token exceeds this "
        "misses SLO, attributed to decode.  0 disables the TPOT "
        "deadline")
+# preemptive scheduling + HBM->host KV tiering (serving/engine.py +
+# serving/kv_cache.py HostTier): when paged admission would block on a
+# full pool, a victim selector preempts a running slot instead of
+# waiting for retirement
+DEFINE("serving_preempt", "off",
+       "ServingEngine default preemption mode when paged admission "
+       "blocks on pool_full: 'off' (FIFO-blocking, the historical "
+       "behavior), 'swap' (victim's private blocks move to the pinned "
+       "host pool and the request resumes with its exact KV restored), "
+       "or 'recompute' (victim's blocks are freed and the request "
+       "re-prefills through the prefix trie on resume).  Engine "
+       "constructor arg overrides")
+DEFINE("serving_host_blocks", 0,
+       "capacity of the host-RAM KV tier in blocks (same geometry as "
+       "the device pool).  >0 arms HBM->host demotion of cold prefix-"
+       "trie blocks (re-promoted on a prefix hit) and is required for "
+       "preempt mode 'swap' (pinned swap buffers share this pool; "
+       "pinned records always win over demoted trie entries).  0 "
+       "disables the tier")
+DEFINE("serving_preempt_after", 2,
+       "admission must have blocked for this many consecutive ticks "
+       "before a waiter may preempt a SAME-priority victim (strictly "
+       "lower-priority victims are preempted immediately); guards "
+       "against churn under transient pressure")
 # cost model + perf sentinel (paddle_tpu/observability/costmodel.py,
 # regression.py): per-tick analytical roofline, measured-vs-predicted
 # attribution, and EWMA anomaly/drift detection (BASELINE.md "Cost-model

@@ -62,12 +62,15 @@ class HardwareProfile:
 
     ``peak_bf16_flops``: dense bf16 FLOP/s; ``hbm_gbps``: HBM stream
     bandwidth in GB/s (decimal GB, matching the BENCH conventions
-    block); ``ici_gbps``: per-chip interconnect bandwidth in GB/s."""
+    block); ``ici_gbps``: per-chip interconnect bandwidth in GB/s;
+    ``host_gbps``: host↔HBM (PCIe/DMA) bandwidth in GB/s — the KV
+    swap/tiering link (ISSUE 16); 0 falls back to ``hbm_gbps``."""
 
     name: str
     peak_bf16_flops: float
     hbm_gbps: float
     ici_gbps: float
+    host_gbps: float = 0.0
 
     @property
     def hbm_bps(self) -> float:
@@ -77,11 +80,16 @@ class HardwareProfile:
     def ici_bps(self) -> float:
         return self.ici_gbps * 1e9
 
+    @property
+    def host_bps(self) -> float:
+        return (self.host_gbps or self.hbm_gbps) * 1e9
+
     def as_dict(self) -> Dict[str, Any]:
         return {"name": self.name,
                 "peak_bf16_flops": self.peak_bf16_flops,
                 "hbm_gbps": self.hbm_gbps,
-                "ici_gbps": self.ici_gbps}
+                "ici_gbps": self.ici_gbps,
+                "host_gbps": self.host_gbps}
 
 
 # v5e numbers are seeded from the committed BENCH_DECODE.json
@@ -93,10 +101,15 @@ class HardwareProfile:
 # arithmetic and determinism on CPU, where absolute milliseconds are
 # meaningless and only ratios/bounds are gated.
 PROFILES: Dict[str, HardwareProfile] = {
+    # host_gbps: no committed measurement either — PCIe Gen3 x16
+    # nominal (16 GB/s) stands in for the v5e host DMA link; cpu_smoke
+    # again only needs a stable, deliberately-small value
     "v5e": HardwareProfile("v5e", peak_bf16_flops=197e12,
-                           hbm_gbps=675.0, ici_gbps=200.0),
+                           hbm_gbps=675.0, ici_gbps=200.0,
+                           host_gbps=16.0),
     "cpu_smoke": HardwareProfile("cpu_smoke", peak_bf16_flops=5e10,
-                                 hbm_gbps=20.0, ici_gbps=2.0),
+                                 hbm_gbps=20.0, ici_gbps=2.0,
+                                 host_gbps=4.0),
 }
 
 
@@ -138,7 +151,7 @@ def kv_bytes_per_token(config: Any, kv_dtype: str, *,
     return float(tok * native)
 
 
-_BOUNDS = ("weight-stream", "kv-stream", "compute", "comm")
+_BOUNDS = ("weight-stream", "kv-stream", "compute", "comm", "swap")
 
 
 def _bucket(n: int) -> int:
@@ -165,7 +178,8 @@ class CostModel:
         self.num_slots = int(num_slots)
         self._comm_bytes_fn = comm_bytes_fn
         self._comm_bytes: Optional[int] = None
-        self._memo: Dict[Tuple[int, int, int, int], Dict[str, Any]] = {}
+        self._memo: Dict[Tuple[int, int, int, int, int],
+                         Dict[str, Any]] = {}
 
     @property
     def comm_bytes_per_step(self) -> int:
@@ -177,13 +191,16 @@ class CostModel:
         return self._comm_bytes
 
     def predict(self, occ: int, live_tokens: int, chunk_tokens: int = 0,
-                window: int = 1) -> Dict[str, Any]:
+                window: int = 1, swap_bytes: int = 0) -> Dict[str, Any]:
         """Roofline for one tick at the given occupancy / live context
         depth / prefill-chunk length / decode window (spec_k+1 under
-        speculative decoding).  Memoized per (occ, depth-bucket, chunk,
-        window); the returned dict is shared — treat it as frozen."""
+        speculative decoding) / host↔HBM swap traffic (preemption
+        swap-outs, tier demotions/promotions — exact bytes, not
+        bucketed: swap volume is quantized to whole blocks already).
+        Memoized per (occ, depth-bucket, chunk, window, swap); the
+        returned dict is shared — treat it as frozen."""
         key = (int(occ), _bucket(live_tokens), int(chunk_tokens),
-               int(window))
+               int(window), int(swap_bytes))
         hit = self._memo.get(key)
         if hit is not None:
             return hit
@@ -201,16 +218,23 @@ class CostModel:
         tokens = self.num_slots * max(1, int(window)) + int(chunk_tokens)
         compute_ms = 2.0 * self.n_params * tokens / p.peak_bf16_flops * 1e3
         comm_ms = self.comm_bytes_per_step / p.ici_bps * 1e3
+        # swap: host<->HBM block copies ride the host DMA link and are
+        # serialized against the tick's dispatch (the engine moves them
+        # between dispatches), so they bound the tick when they dominate
+        swap_ms = int(swap_bytes) / p.host_bps * 1e3
         hbm_ms = weight_ms + kv_ms
-        predicted = max(hbm_ms, compute_ms, comm_ms)
+        predicted = max(hbm_ms, compute_ms, comm_ms, swap_ms)
         if predicted == hbm_ms:
             bound = "weight-stream" if weight_ms >= kv_ms else "kv-stream"
         elif predicted == compute_ms:
             bound = "compute"
-        else:
+        elif predicted == comm_ms:
             bound = "comm"
+        else:
+            bound = "swap"
         out = {"weight_stream_ms": weight_ms, "kv_stream_ms": kv_ms,
                "compute_ms": compute_ms, "comm_ms": comm_ms,
+               "swap_ms": swap_ms,
                "predicted_ms": predicted, "bound": bound,
                "live_tokens_bucket": key[1]}
         self._memo[key] = out
@@ -269,7 +293,7 @@ class TickAttribution:
             self._bounds: Dict[str, Dict[str, float]] = {}
             self._terms = {"weight_stream_ms": 0.0, "kv_stream_ms": 0.0,
                            "compute_ms": 0.0, "comm_ms": 0.0,
-                           "predicted_ms": 0.0}
+                           "swap_ms": 0.0, "predicted_ms": 0.0}
             self._ratios: List[float] = []
             self._drift: Dict[str, Dict[str, Any]] = {}
             # one two-sided ratio detector per bound feeds the drift
@@ -302,10 +326,12 @@ class TickAttribution:
         return h
 
     def on_tick(self, measured_ms: float, *, occ: int, live_tokens: int,
-                chunk_tokens: int = 0, window: int = 1) -> Dict[str, Any]:
+                chunk_tokens: int = 0, window: int = 1,
+                swap_bytes: int = 0) -> Dict[str, Any]:
         """Record one measured tick against its prediction.  Returns the
         prediction breakdown (shared memoized dict — do not mutate)."""
-        pred = self.model.predict(occ, live_tokens, chunk_tokens, window)
+        pred = self.model.predict(occ, live_tokens, chunk_tokens, window,
+                                  swap_bytes)
         bound = pred["bound"]
         ratio = float(measured_ms) / max(pred["predicted_ms"], 1e-12)
         with self._lock:

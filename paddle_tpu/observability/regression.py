@@ -291,6 +291,21 @@ def check_history(root: Optional[str] = None,
             "perf_model_row", ok,
             f"drift_findings={pm.get('drift_findings')} "
             f"kv_ratio_consistent={pm.get('kv_ratio_consistent')}"))
+    # preempt_serving (ISSUE 16): the committed A/B must keep the
+    # preemptive engines' goodput win, token-identity across all three
+    # engines, and the byte-stable victim-decision signature
+    ps = cpu.get("preempt_serving", {})
+    if ps:
+        ok = (bool(ps.get("preempt_goodput_strictly_better"))
+              and bool(ps.get("outputs_token_identical"))
+              and bool(ps.get("preempt_signature_stable")))
+        checks.append(_check(
+            "preempt_serving_row", ok,
+            f"goodput_strictly_better="
+            f"{ps.get('preempt_goodput_strictly_better')} "
+            f"token_identical={ps.get('outputs_token_identical')} "
+            f"decision_signature_stable="
+            f"{ps.get('preempt_signature_stable')}"))
 
     ok = all(c["ok"] is not False for c in checks)
     return {"ok": ok, "root": root, "tolerances": tol, "checks": checks}

@@ -12,7 +12,11 @@ lifecycle transition appends a structured event here:
   ``spec_accept``* → ``retired``
 
 plus ``admission_wait`` when a paged pool defers admission (the
-preemption-relevant wait).  Each event also mirrors into the span
+preemption-relevant wait), and — under the preemptive scheduler —
+``preempted`` → (``swapped_out`` → ``swapped_in``)? → ``resumed``
+mid-decode cycles (any number of them per request) and a terminal
+``retired`` with ``violation="cancelled"`` when ``cancel(rid)`` pulls
+the request mid-flight.  Each event also mirrors into the span
 tracer as a ``request.<name>`` instant with the uid as correlation arg,
 so the per-request story lines up against the host span timeline in one
 Perfetto load.
@@ -32,7 +36,7 @@ Three read surfaces:
     submit from FLAGS_serving_slo_ttft_ms / FLAGS_serving_slo_tpot_ms,
     or explicit overrides) into goodput (fraction + tok/s of
     SLO-attaining requests) and a violation breakdown by cause
-    (rejected / queue_wait / prefill / decode).
+    (rejected / cancelled / queue_wait / prefill / decode).
 
 Cost discipline: one lock + one list append per event, no device work;
 events fire at scheduling transitions only (admission, chunk, accept,
@@ -208,7 +212,11 @@ class RequestLog:
                     ("queued", "submitted", "admitted"),
                     ("queued", "submitted", "rejected"),
                     ("prefill", "admitted", "first_token"),
-                    ("decode", "first_token", "retired")):
+                    ("decode", "first_token", "retired"),
+                    # gap the preemptive scheduler evicted this request
+                    # for (first preemption to first resume; nested
+                    # cycles merge into one slice)
+                    ("preempted", "preempted", "resumed")):
                 if a in t_of and b in t_of and t_of[b] >= t_of[a]:
                     events.append({
                         "name": phase, "cat": "request", "ph": "X",
@@ -245,18 +253,19 @@ class RequestLog:
         goodput denominator counts EVERY submitted request, rejected
         ones included; TTFT is measured from submit, not admit; a
         violating request is attributed to exactly one cause —
-        ``rejected``, else a missed TTFT to its larger segment
-        (``queue_wait`` vs ``prefill``), else a missed TPOT to
-        ``decode``; a request still in flight counts as ``incomplete``
-        (never SLO-attaining)."""
+        ``rejected``, else ``cancelled`` (retired via ``cancel(rid)``
+        — rejected-style: in the denominator, never attaining), else a
+        missed TTFT to its larger segment (``queue_wait`` vs
+        ``prefill``), else a missed TPOT to ``decode``; a request still
+        in flight counts as ``incomplete`` (never SLO-attaining)."""
         recs = self.records(since_uid, until_uid)
         total = len(recs)
         attained = 0
         attained_tokens = 0
         ttfts: List[float] = []
         tpots: List[float] = []
-        viol = {"rejected": 0, "queue_wait": 0, "prefill": 0,
-                "decode": 0, "incomplete": 0}
+        viol = {"rejected": 0, "cancelled": 0, "queue_wait": 0,
+                "prefill": 0, "decode": 0, "incomplete": 0}
         recorded_targets = set()
         for rec in recs.values():
             by = {}
@@ -274,6 +283,9 @@ class RequestLog:
             ret = by.get("retired")
             if ret is None:
                 viol["incomplete"] += 1
+                continue
+            if ret.get("reason") == "cancelled":
+                viol["cancelled"] += 1
                 continue
             ttft = ret.get("ttft_ms")
             tpot = ret.get("tpot_ms")

@@ -140,7 +140,9 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import hashlib
 import itertools
+import json
 import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
@@ -206,9 +208,11 @@ class _Rejected(Exception):
         self.reason = reason
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class Request:
-    """A queued generation request (created by ``submit``)."""
+    """A queued generation request (created by ``submit``).  Identity
+    equality (``eq=False``): scheduler queues remove entries by object
+    identity, and the numpy ``prompt`` field has no scalar ``==``."""
 
     request_id: int
     prompt: np.ndarray                 # (plen,) int32
@@ -220,6 +224,11 @@ class Request:
     ttft_slo_ms: float = 0.0           # deadlines recorded at submit;
     tpot_slo_ms: float = 0.0           # 0 = that deadline disabled
     blocked_ticks: int = 0             # pool-full admission deferrals
+    priority: int = 0                  # preemption class (higher wins)
+    preempt_count: int = 0             # times this request was preempted
+    # recompute-resume marker: set ONLY on the synthetic re-prefill
+    # request a recompute preemption enqueues (see _do_preempt)
+    resume: Optional["_ResumeInfo"] = None
 
 
 @dataclasses.dataclass
@@ -241,6 +250,35 @@ class _Prefill:
     req: Request
     slot: int
     cursor: int                        # prompt tokens already in the cache
+
+
+@dataclasses.dataclass
+class _ResumeInfo:
+    """Recompute-resume bookkeeping, attached to the synthetic request a
+    recompute preemption enqueues: the re-prefill covers the original
+    prompt plus every committed token but the last; at slot re-creation
+    the re-sampled token is DISCARDED and ``last_token`` forced back, so
+    the resumed decode continues exactly where the victim stopped."""
+
+    orig: Request                      # the preempted request
+    last_token: int                    # last committed token (forced back)
+    remaining: int                     # decode budget left at preemption
+    t_first: float                     # original TTFT clock (preserved)
+
+
+@dataclasses.dataclass
+class _SwapResume:
+    """A swapped-out (preempted) request parked on the host tier: the
+    BlockManager swap record plus the exact host-mirror state needed to
+    restore the slot bit-for-bit once pool space frees up."""
+
+    req: Request
+    record: Dict[str, object]          # BlockManager.swap_out record
+    last_token: int
+    position: int
+    remaining: int
+    t_first: float
+    blocked_ticks: int = 0             # failed resume attempts
 
 
 class ServingEngine:
@@ -267,7 +305,9 @@ class ServingEngine:
                  spec_k: Optional[int] = None,
                  kv_cache_dtype: Optional[str] = None,
                  int8_weights: Optional[bool] = None,
-                 mesh=None):
+                 mesh=None,
+                 preempt: Optional[str] = None,
+                 host_blocks: Optional[int] = None):
         """``paged`` (default FLAGS_serving_paged_kv) selects the paged
         block-pool cache; ``block_len`` (FLAGS_kv_cache_block_len) and
         ``num_blocks`` (FLAGS_kv_cache_num_blocks; 0 derives the
@@ -385,6 +425,30 @@ class ServingEngine:
             self._drafter = NgramDrafter(
                 self.spec_k,
                 max_ngram=int(_flags.flag("serving_spec_ngram")))
+        # preemptive scheduling + host KV tier (ISSUE 16).  'swap'
+        # parks a victim's private blocks on the pinned host pool and
+        # restores them verbatim; 'recompute' frees the chain and
+        # re-prefills prompt+committed tokens through the prefix trie.
+        # Both are host-side pool surgery + block-table updates — the
+        # once-jitted step never sees a new trace.
+        self.preempt = str(_flags.flag("serving_preempt")
+                           if preempt is None else preempt)
+        if self.preempt not in ("off", "swap", "recompute"):
+            raise ValueError(
+                f"preempt must be off|swap|recompute, got "
+                f"{self.preempt!r}")
+        if self.preempt != "off" and not self.paged:
+            raise ValueError(
+                "preemption requires the paged cache: victim block free "
+                "and swap/recompute resume are BlockManager operations")
+        self._preempt_after = int(_flags.flag("serving_preempt_after"))
+        hb = int(_flags.flag("serving_host_blocks")
+                 if host_blocks is None else host_blocks)
+        if self.preempt == "swap" and hb < 1:
+            raise ValueError(
+                "preempt='swap' needs a host tier: pass host_blocks "
+                "(or FLAGS_serving_host_blocks) >= 1")
+        self._host_blocks = hb if self.paged else 0
         self.mesh = self._resolve_mesh(mesh)
         self._init_metrics()
 
@@ -406,7 +470,8 @@ class ServingEngine:
                 nb, bl,
                 prefix_cache=bool(_flags.flag("serving_prefix_cache")
                                   if prefix_cache is None else prefix_cache),
-                kv_dtype=self.kv_dtype)
+                kv_dtype=self.kv_dtype,
+                host_blocks=self._host_blocks)
             cache = init_paged_kv_cache(model.config, nb, bl,
                                         quantized=self.quantized)
             # arm the pool's bytes_by_dtype gauges with this model's
@@ -508,6 +573,50 @@ class ServingEngine:
                                             with_params=False)
                    if self.mesh is not None else {}))
             self.kv.on_demote = self._pending_demote.extend
+        self._tick_swap_bytes = 0      # host<->HBM bytes moved this tick
+        if self.paged and self._host_blocks > 0:
+            # host-tier block movers (swap-out reads / swap-in writes one
+            # pool block), each jitted ONCE with a traced block id — a
+            # different block is different DATA, not a different trace,
+            # so the retrace budget of 1 holds for every swap volume.
+            # The read fn does NOT donate (the pool is read again); the
+            # write fn donates the pool and the engine rebinds it, same
+            # aliasing contract as the step.  Both map over the cache
+            # pytree, so the int8 {kv, scale} pool moves a block's scale
+            # row together with its payload — a swap round trip restores
+            # quantized blocks bit-for-bit.
+            def _read_block_impl(c, bid):
+                return jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, bid, 1, axis=2), c)
+
+            def _write_block_impl(c, payload, bid):
+                return jax.tree_util.tree_map(
+                    lambda a, p: jax.lax.dynamic_update_slice_in_dim(
+                        a, p, bid, axis=2), c, payload)
+            read_kwargs, write_kwargs = {}, {}
+            if self.mesh is not None:
+                # the one-block payload keeps the pool's per-leaf specs
+                # (only the head dim is sharded; the block axis never is,
+                # so a single-block slice stays on-device-local)
+                sh = self._mesh_jit_shardings(2, 1, cache_argnum=0,
+                                              with_params=False)
+                read_kwargs = dict(in_shardings=sh["in_shardings"],
+                                   out_shardings=sh["out_shardings"])
+                write_kwargs = dict(
+                    in_shardings=(sh["in_shardings"][0],
+                                  sh["out_shardings"],
+                                  sh["in_shardings"][1]),
+                    out_shardings=sh["out_shardings"])
+            self._read_block_fn = _obs.track_retraces(
+                _read_block_impl, "serving.swap_read", budget=1,
+                labels={"engine": self._eid}, **read_kwargs)
+            self._write_block_fn = _obs.track_retraces(
+                _write_block_impl, "serving.swap_write", budget=1,
+                labels={"engine": self._eid}, donate_argnums=(0,),
+                **write_kwargs)
+            self.kv.on_swap_out = self._host_swap_out
+            self.kv.on_swap_in = self._host_swap_in
 
         # host-side mirrors of the step inputs (tiny; re-uploaded per tick)
         s = self.num_slots
@@ -521,6 +630,13 @@ class ServingEngine:
         self._slots: List[Optional[_Slot]] = [None] * s
         self._prefill: Optional[_Prefill] = None   # chunked-mode cursor
         self._queue: Deque[Request] = deque()
+        # preempted work awaiting resume, each kept sorted by
+        # (-priority, request id) so resume order is deterministic
+        self._swap_resume: List[_SwapResume] = []
+        self._resume_q: Deque[Request] = deque()
+        # every preemption decision, in order — preempt_signature()
+        # hashes this list, the loadgen saturated gate replays it
+        self._preempt_log: List[Dict[str, object]] = []
         self._results: Dict[int, List[int]] = {}
         self._next_rid = 0
         self._base_key = jax.random.key(seed)
@@ -634,14 +750,19 @@ class ServingEngine:
                    chunk_tokens: int = 0) -> None:
         """Stamp one measured tick with the model's prediction at the
         tick's ACTUAL occupancy / live depths / chunk state (positions
-        are still pre-advance here — the depths the step just read)."""
+        are still pre-advance here — the depths the step just read).
+        Host↔HBM bytes any swap/demotion moved since the last dispatch
+        ride along — the roofline's swap term (costmodel.py) bounds the
+        tick by host-link bandwidth when they dominate."""
+        swap_bytes, self._tick_swap_bytes = self._tick_swap_bytes, 0
         if self._perf is None:
             return
         live = int(self._positions[self._active].sum()) if occ else 0
         self._perf.on_tick(
             measured_ms, occ=occ, live_tokens=live,
             chunk_tokens=chunk_tokens,
-            window=self.spec_k + 1 if self.spec else 1)
+            window=self.spec_k + 1 if self.spec else 1,
+            swap_bytes=swap_bytes)
 
     def perf_report(self) -> Dict[str, object]:
         """Predicted-vs-measured attribution for this engine: per-bound
@@ -863,6 +984,29 @@ class ServingEngine:
             "jit.traces", "").labels(site="serving.step", **lbl)
         self._m_prefill_traces = ctr(
             "jit.traces", "").labels(site="serving.prefill", **lbl)
+        # preemptive scheduling + host KV tier (ISSUE 16; BASELINE.md
+        # "Preemption accounting conventions": swap bytes are pool
+        # traffic, NEVER streamed-KV bytes)
+        self._f_preempt = ctr(
+            "serving.preemptions",
+            "running slots evicted at blocked admission, by resume "
+            "mode: swap (chain parked on the host tier) | recompute "
+            "(chain freed, re-prefilled through the prefix trie)")
+        self._f_resumed = ctr(
+            "serving.resumes",
+            "preempted requests restored to a slot, by mode")
+        self._m_swap_out_bytes = ctr(
+            "serving.swap_out_bytes",
+            "HBM→host bytes moved by swap-outs and trie demotions "
+            "(pool traffic, not streamed KV bytes)").labels(**lbl)
+        self._m_swap_in_bytes = ctr(
+            "serving.swap_in_bytes",
+            "host→HBM bytes moved by swap-ins and trie "
+            "promotions").labels(**lbl)
+        self._m_cancelled = ctr(
+            "serving.cancelled",
+            "cancel() calls that found and tore down a live "
+            "request").labels(**lbl)
 
     # -- jitted device programs -------------------------------------------
 
@@ -1104,7 +1248,8 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: int = 32,
                sampling: Optional[SamplingParams] = None,
-               request_uid: Optional[int] = None) -> int:
+               request_uid: Optional[int] = None,
+               priority: int = 0) -> int:
         """Enqueue a request; returns its id.  Admission happens inside
         ``step()`` as slots free up (FIFO).
 
@@ -1112,7 +1257,13 @@ class ServingEngine:
         router minted it and already logged ``submitted``); direct
         callers leave it None and the engine mints one — either way the
         uid correlates every later lifecycle event, across replicas on
-        failover included."""
+        failover included.
+
+        ``priority`` is the preemption class (higher wins; default 0).
+        With ``preempt`` armed, the queue admits by priority class
+        (stable FIFO within a class) and a blocked admission may evict
+        a running lower-priority request — see ``_try_preempt`` for
+        the victim selection contract."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if request_uid is None:
             uid = self._rlog.new_uid()
@@ -1160,7 +1311,8 @@ class ServingEngine:
             sampling or SamplingParams(),
             t_submit=time.perf_counter(), uid=uid,
             ttft_slo_ms=float(_flags.flag("serving_slo_ttft_ms")),
-            tpot_slo_ms=float(_flags.flag("serving_slo_tpot_ms"))))
+            tpot_slo_ms=float(_flags.flag("serving_slo_tpot_ms")),
+            priority=int(priority)))
         self._m_submitted.inc()
         return rid
 
@@ -1177,7 +1329,8 @@ class ServingEngine:
         Idle ticks (no queued work, no active slots — the poll loop of a
         server waiting for traffic) return immediately: no admission
         scan, no device dispatch of a fully-masked decode step."""
-        if (not self._queue and not self._active.any()
+        if (not self._queue and not self._resume_q
+                and not self._swap_resume and not self._active.any()
                 and self._prefill is None):
             self._set_occupancy(0)
             return []
@@ -1247,6 +1400,299 @@ class ServingEngine:
         for bid in pending:
             self._cache = self._demote_fn(self._cache, jnp.int32(bid))
         self._m_demoted.inc(len(pending))
+
+    # -- host tier plumbing (swap hooks) -----------------------------------
+
+    def _host_swap_out(self, pairs):
+        """BlockManager ``on_swap_out`` hook: copy each ``(bid, hid)``
+        pair's device block into its host buffer.  The ``device_get``
+        is the synchronization point — the payload lands on the host
+        BEFORE ``swap_out``/``_evict_one`` returns the physical block to
+        the free list, so a re-allocation can never race the copy."""
+        tier = self.kv.host_tier
+        for bid, hid in pairs:
+            payload = jax.device_get(
+                self._read_block_fn(self._cache, jnp.int32(bid)))
+            tier.put(hid, payload)
+            nbytes = sum(int(a.nbytes) for a in
+                         jax.tree_util.tree_leaves(payload))
+            self._tick_swap_bytes += nbytes
+            self._m_swap_out_bytes.inc(nbytes)
+
+    def _host_swap_in(self, pairs):
+        """BlockManager ``on_swap_in`` hook: write each ``(hid, bid)``
+        pair's host payload back into its (re)allocated device block.
+        The write fn donates the pool — same in-place aliasing contract
+        as the step — and runs strictly between dispatches, so the
+        once-jitted step never observes a swap as a new trace."""
+        tier = self.kv.host_tier
+        for hid, bid in pairs:
+            payload = jax.tree_util.tree_map(jnp.asarray, tier.get(hid))
+            self._cache = self._write_block_fn(self._cache, payload,
+                                               jnp.int32(bid))
+            nbytes = sum(int(a.nbytes) for a in
+                         jax.tree_util.tree_leaves(payload))
+            self._tick_swap_bytes += nbytes
+            self._m_swap_in_bytes.inc(nbytes)
+
+    # -- preemptive scheduling (ISSUE 16) ----------------------------------
+
+    def _try_preempt(self, *, priority: int, rid: int,
+                     blocked_ticks: int) -> bool:
+        """Pick and preempt ONE victim so the blocked waiter's admission
+        can retry.  Victim selection is the BASELINE.md determinism
+        contract — a pure function of schedule state, ranked by
+        (priority ASC, loosest TTFT SLO first, shortest progress,
+        youngest request, slot index).  The SLO key is the RELATIVE
+        budget, deliberately not a submit-anchored absolute deadline:
+        t_submit is wall clock, and ranking on it would make victim
+        selection timing-dependent, breaking the byte-stable replay
+        signature (no-SLO victims rank as infinitely loose, i.e. first):
+
+          * a strictly-lower-priority victim is preempted immediately;
+          * a same-priority victim only after the waiter has been
+            blocked ``FLAGS_serving_preempt_after`` consecutive ticks,
+            and never one that was itself already preempted once —
+            together these stop two equal-priority requests from
+            swapping each other forever.
+
+        Returns True if a victim was preempted (the caller retries
+        admission), False if nobody is eligible."""
+        if self.preempt == "off":
+            return False
+        cands = []
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.req is None:
+                continue
+            vr = slot.req
+            if vr.priority < priority:
+                pass                       # strictly lower: immediate
+            elif (vr.priority == priority
+                  and blocked_ticks >= self._preempt_after
+                  and vr.preempt_count == 0):
+                pass                       # FIFO fairness gate passed
+            else:
+                continue
+            dl = vr.ttft_slo_ms if vr.ttft_slo_ms > 0 else float("inf")
+            prog = len(self._results[vr.request_id])
+            cands.append(((vr.priority, -dl, prog, -vr.request_id, i), i))
+        if not cands:
+            return False
+        _, victim = min(cands)
+        self._do_preempt(victim, waiter_rid=rid)
+        return True
+
+    def _do_preempt(self, i: int, waiter_rid: int):
+        """Evict slot ``i``'s request: swap its private blocks to the
+        host tier (falling back to recompute if the tier can't take
+        them) or free the chain for recompute-from-prefix, then park the
+        request on the matching resume queue."""
+        slot = self._slots[i]
+        req = slot.req
+        mode = self.preempt
+        record = None
+        if mode == "swap":
+            record = self.kv.swap_out(i)
+            if record is None:
+                mode = "recompute"  # host tier full: degrade gracefully
+        if mode == "recompute":
+            self.kv.preempt_free(i)
+        req.preempt_count += 1
+        gen = self._results[req.request_id]
+        self._preempt_log.append({
+            "tick": self._ticks, "victim_rid": req.request_id,
+            "waiter_rid": waiter_rid, "mode": mode, "slot": i,
+            "progress": len(gen)})
+        self._f_preempt.labels(engine=self._eid, mode=mode).inc()
+        self._tracer.instant("serving.preempted", rid=req.request_id,
+                             mode=mode, slot=i)
+        self._rlog.event(req.uid, "preempted", engine=self._eid,
+                         mode=mode, slot=int(i), tokens=len(gen),
+                         waiter=int(waiter_rid))
+        if mode == "swap":
+            n_host = sum(1 for e in record["entries"] if e[0] == "host")
+            self._rlog.event(req.uid, "swapped_out", engine=self._eid,
+                             blocks=len(record["entries"]),
+                             host_blocks=int(n_host))
+            self._push_swap_resume(_SwapResume(
+                req=req, record=record,
+                last_token=int(self._tokens[i]),
+                position=int(self._positions[i]),
+                remaining=slot.remaining, t_first=slot.t_first))
+        else:
+            # recompute: the synthetic resume request re-prefills the
+            # prompt plus every committed token but the last through the
+            # prefix trie.  The cache covered positions
+            # [0, plen + n_gen - 1) at preemption, which is EXACTLY
+            # len(prompt ++ gen[:-1]) — and blocks_needed(plen2, rem+1)
+            # equals the original reservation, so resume admission can
+            # never demand more blocks than first admission did.
+            prompt2 = (np.concatenate(
+                [req.prompt, np.asarray(gen[:-1], np.int32)])
+                if len(gen) > 1 else req.prompt)
+            self._push_resume_q(dataclasses.replace(
+                req, prompt=prompt2, max_new_tokens=slot.remaining + 1,
+                blocked_ticks=0,
+                resume=_ResumeInfo(orig=req, last_token=int(gen[-1]),
+                                   remaining=slot.remaining,
+                                   t_first=slot.t_first)))
+        self._clear_slot(i)
+
+    def _push_swap_resume(self, entry: _SwapResume):
+        self._swap_resume.append(entry)
+        self._swap_resume.sort(
+            key=lambda e: (-e.req.priority, e.req.request_id))
+
+    def _push_resume_q(self, req: Request):
+        # re-order IN PLACE: admission may hold a reference to this
+        # deque across a preemption that pushes here (the retry loop)
+        self._resume_q.append(req)
+        if len(self._resume_q) > 1:
+            items = sorted(self._resume_q,
+                           key=lambda r: (-r.priority, r.request_id))
+            self._resume_q.clear()
+            self._resume_q.extend(items)
+
+    def _next_admit(self) -> Tuple[Deque, Request]:
+        """Pick the next request to admit and the queue it lives in.
+
+        With preemption off: resume entries (there are none unless
+        preemption ran) then strict submit FIFO.  With preemption armed
+        the choice spans BOTH queues by ``(-priority, request_id)`` —
+        a priority submit is a scheduling request; parking it behind a
+        blocked lower-priority recompute-resume head would undo the
+        victim selector's work one queue position earlier (and vice
+        versa, a resume entry never jumps a higher-priority submit).
+        Scanning the resume queue first makes resume entries win exact
+        ties, though ids are unique so ties cannot actually occur."""
+        if self.preempt == "off":
+            src = self._resume_q if self._resume_q else self._queue
+            return src, src[0]
+        best: Optional[Tuple[Tuple[int, int], Deque, Request]] = None
+        for q in (self._resume_q, self._queue):
+            for r in q:
+                key = (-r.priority, r.request_id)
+                if best is None or key < best[0]:
+                    best = (key, q, r)
+        assert best is not None
+        return best[1], best[2]
+
+    def _service_swap_resumes(self):
+        """Admission preamble: restore swapped-out requests (highest
+        priority, then oldest, first) into free slots whenever the pool
+        can hold their chain again.  A blocked high-priority resume may
+        itself preempt a running lower-priority slot — swap-out and
+        swap-in compose without ever touching the step program."""
+        while self._swap_resume:
+            entry = self._swap_resume[0]
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if self._prefill is not None:
+                # chunked mode: the mid-prefill slot owns a kv chain but
+                # no _Slot yet — it is NOT free
+                free = [i for i in free if i != self._prefill.slot]
+            if not free:
+                return
+            si = free[0]
+            got = self.kv.resume_swapped(si, entry.record)
+            if got is None:
+                entry.blocked_ticks += 1
+                if not self._try_preempt(priority=entry.req.priority,
+                                         rid=entry.req.request_id,
+                                         blocked_ticks=entry.blocked_ticks):
+                    return
+                continue                   # a victim freed room: retry
+            self._swap_resume.pop(0)
+            req = entry.req
+            # restore the EXACT pre-preemption slot state: mirrors,
+            # table row, decode budget, original TTFT clock
+            self._slots[si] = _Slot(req.request_id, entry.remaining,
+                                    t_first=entry.t_first,
+                                    prompt=req.prompt, req=req)
+            self._active[si] = True
+            self._tokens[si] = entry.last_token
+            self._positions[si] = entry.position
+            self._temps[si] = req.sampling.temperature
+            self._topk[si] = req.sampling.top_k
+            self._topp[si] = req.sampling.top_p
+            self._tables[si] = self.kv.table_row(si, self.max_blocks)
+            # re-register the prompt so prefix sharing resumes (the
+            # round trip preserved per-block dtype tags, so mixed-mode
+            # re-registration never re-demotes an int8 block)
+            self.kv.register_prompt_upto(si, req.prompt,
+                                         int(req.prompt.size))
+            self._rlog.event(req.uid, "swapped_in", engine=self._eid,
+                             slot=int(si), blocks=int(got))
+            self._rlog.event(req.uid, "resumed", engine=self._eid,
+                             mode="swap", slot=int(si))
+            self._f_resumed.labels(engine=self._eid, mode="swap").inc()
+            self._tracer.instant("serving.resumed", rid=req.request_id,
+                                 mode="swap", slot=int(si))
+
+    def preempt_signature(self) -> str:
+        """SHA-256 over the ordered preemption-decision log (victim,
+        waiter, tick, mode, progress per decision) — the byte-stability
+        gate loadgen's saturated smoke replays: identical traffic must
+        reproduce identical victim selection."""
+        blob = json.dumps(self._preempt_log, sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    @property
+    def preempt_decisions(self) -> List[Dict[str, object]]:
+        return list(self._preempt_log)
+
+    # -- cancellation (ISSUE 16 satellite) ---------------------------------
+
+    def cancel(self, rid: int) -> bool:
+        """Tear down request ``rid`` wherever it currently lives —
+        queued, awaiting recompute-resume, swapped out on the host tier,
+        mid-chunked-prefill, or actively decoding — with refcount-safe
+        block free, a ``retired(reason="cancelled")`` lifecycle event
+        and rejected-style SLO accounting.  Returns True if the request
+        was found and torn down, False if unknown or already finished.
+        Partial output (if any) stays readable via ``result()``."""
+        for q in (self._queue, self._resume_q):
+            for req in q:
+                if req.request_id == rid:
+                    q.remove(req)
+                    self._finish_cancel(
+                        req if req.resume is None else req.resume.orig)
+                    return True
+        for k, entry in enumerate(self._swap_resume):
+            if entry.req.request_id == rid:
+                self._swap_resume.pop(k)
+                self.kv.drop_swap_record(entry.record)
+                self._finish_cancel(entry.req)
+                return True
+        pf = self._prefill
+        if pf is not None and pf.req.request_id == rid:
+            # mid-chunked-prefill: the slot owns a kv chain (admission
+            # reserved it) but no _Slot/mirror state yet
+            self._prefill = None
+            if self.paged:
+                self.kv.release(pf.slot)
+                self._tables[pf.slot] = 0
+            self._finish_cancel(
+                pf.req if pf.req.resume is None else pf.req.resume.orig)
+            return True
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.rid == rid:
+                req = slot.req
+                self._release(i)
+                self._finish_cancel(req)
+                return True
+        return False
+
+    def _finish_cancel(self, req: Request):
+        tokens = len(self._results.get(req.request_id, []))
+        self._m_finished.inc()
+        self._m_cancelled.inc()
+        self._f_retired.labels(engine=self._eid, reason="cancelled").inc()
+        self._f_slo_viol.labels(engine=self._eid, kind="cancelled").inc()
+        self._rlog.event(req.uid, "retired", engine=self._eid,
+                         reason="cancelled", tokens=int(tokens),
+                         violation="cancelled")
+        self._tracer.instant("serving.cancelled", rid=req.request_id)
 
     def _step_inner(self) -> List[int]:
         finished = self._admit()
@@ -1575,17 +2021,25 @@ class ServingEngine:
         at a time (FIFO order; the chunk operand is single-slot by
         construction).  Queue-wait is recorded ONCE here — a request
         admitted at tick t waits zero extra queue time for its chunks."""
-        if (self._prefill is not None or not self._queue):
+        if self.paged:
+            self._service_swap_resumes()
+        if (self._prefill is not None
+                or not (self._resume_q or self._queue)):
             return []
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
             return []
-        req = self._queue[0]
+        src, req = self._next_admit()
         si = free[0]
         m = 0
         if self.paged:
             got = self.kv.admit(si, req.prompt, req.prompt.size,
                                 req.max_new_tokens, chunked=True)
+            while got is None and self._try_preempt(
+                    priority=req.priority, rid=req.request_id,
+                    blocked_ticks=req.blocked_ticks):
+                got = self.kv.admit(si, req.prompt, req.prompt.size,
+                                    req.max_new_tokens, chunked=True)
             if got is None:          # pool full: wait for retirements
                 self._m_blocked.inc()
                 self._tracer.instant("serving.admission_blocked",
@@ -1598,20 +2052,23 @@ class ServingEngine:
                                      engine=self._eid, reason="pool_full")
                 return []
             m = got                  # adopted prefix tokens skip compute
-        self._queue.popleft()
+        # remove by IDENTITY: a preemption inside the retry loop may
+        # have re-ordered the resume queue under us
+        src.remove(req)
         if self.quantized and not self.paged:
             # chunked admission streams into a reused row: drop the
             # previous tenant's granule scales before the first chunk
             self._cache = self._row_reset_fn(self._cache, jnp.int32(si))
         now = time.perf_counter()
-        req.t_admit = now
-        self._m_queue_wait.observe((now - req.t_submit) * 1e3)
         self._m_prefill_total.inc(int(req.prompt.size))
-        self._rlog.event(req.uid, "admitted", engine=self._eid,
-                         slot=int(si),
-                         queue_wait_ms=(now - req.t_submit) * 1e3,
-                         blocked_ticks=int(req.blocked_ticks),
-                         prefix_hit_tokens=int(m))
+        if req.resume is None:
+            req.t_admit = now
+            self._m_queue_wait.observe((now - req.t_submit) * 1e3)
+            self._rlog.event(req.uid, "admitted", engine=self._eid,
+                             slot=int(si),
+                             queue_wait_ms=(now - req.t_submit) * 1e3,
+                             blocked_ticks=int(req.blocked_ticks),
+                             prefix_hit_tokens=int(m))
         self._prefill = _Prefill(req, si, int(m))
         return []
 
@@ -1635,25 +2092,43 @@ class ServingEngine:
             return []
         si, req = pf.slot, pf.req
         self._prefill = None
-        slot = _Slot(req.request_id, req.max_new_tokens - 1, t_first=now,
-                     prompt=req.prompt, req=req)
+        ri = req.resume
+        if ri is not None:
+            # recompute resume (chunked): discard the re-sampled token,
+            # force the last committed one back, restore the original
+            # decode budget / TTFT clock — see _prefill_wave_paged
+            first = ri.last_token
+            slot = _Slot(req.request_id, ri.remaining, t_first=ri.t_first,
+                         prompt=ri.orig.prompt, req=ri.orig)
+        else:
+            first = ctok
+            slot = _Slot(req.request_id, req.max_new_tokens - 1,
+                         t_first=now, prompt=req.prompt, req=req)
         self._slots[si] = slot
         self._active[si] = True
-        self._tokens[si] = ctok
+        self._tokens[si] = first
         self._positions[si] = plen
         self._temps[si] = req.sampling.temperature
         self._topk[si] = req.sampling.top_k
         self._topp[si] = req.sampling.top_p
         if self.paged:
             self._tables[si] = self.kv.table_row(si, self.max_blocks)
-        self._results[req.request_id].append(ctok)
+        if ri is not None:
+            self._rlog.event(req.uid, "resumed", engine=self._eid,
+                             mode="recompute", slot=int(si))
+            self._f_resumed.labels(engine=self._eid,
+                                   mode="recompute").inc()
+            self._tracer.instant("serving.resumed", rid=req.request_id,
+                                 mode="recompute", slot=int(si))
+            return []
+        self._results[req.request_id].append(first)
         self._m_tokens.inc()
         self._m_ttft.observe((now - req.t_submit) * 1e3)
         if self._perf is not None:
             self._perf.on_ttft((now - req.t_submit) * 1e3)
         self._rlog.event(req.uid, "first_token", engine=self._eid,
                          ttft_ms=(now - req.t_submit) * 1e3)
-        reason = self._finish_reason(ctok, slot, si)
+        reason = self._finish_reason(first, slot, si)
         if reason is not None:
             self._retire(slot, si, reason, now)
             return [req.request_id]
@@ -1667,7 +2142,7 @@ class ServingEngine:
         if self._prefill is not None:
             n += -(-(self._prefill.req.prompt.size
                      - self._prefill.cursor) // ch)
-        for req in self._queue:
+        for req in itertools.chain(self._resume_q, self._queue):
             n += -(-req.prompt.size // ch)
         return n
 
@@ -1676,7 +2151,8 @@ class ServingEngine:
         ``[(request_id, generated_tokens)]`` in arrival order (outputs end
         at EOS inclusive — no pad tail, unlike the fixed-shape
         ``generate()`` rows)."""
-        while (self._queue or self._prefill is not None
+        while (self._queue or self._resume_q or self._swap_resume
+               or self._prefill is not None
                or any(s is not None for s in self._slots)):
             self.step()
         return [(rid, list(toks))
@@ -1700,6 +2176,12 @@ class ServingEngine:
         prompt whose chunks are streaming in; wave mode: always 0 —
         admission prefills in the same tick)."""
         return int(self._prefill is not None)
+
+    @property
+    def num_preempted(self) -> int:
+        """Preempted requests awaiting resume (swapped-out chains parked
+        on the host tier plus recompute re-prefills still queued)."""
+        return len(self._swap_resume) + len(self._resume_q)
 
     @property
     def pending_chunks(self) -> int:
@@ -1924,6 +2406,10 @@ class ServingEngine:
             "engine_cache_hbm_bytes": int(cb),
             "predicted_cache_bytes": int(predicted),
             "cache_bytes_per_device": int(hbm["cache_bytes_per_device"]),
+            # informational: the KV tier's pinned host-RAM entitlement
+            # — host-side by design, so it never enters the HBM
+            # liveness comparison above
+            "host_tier_bytes": int(self.host_cache_bytes),
             "rel_err": round(rel, 6), "tol": tol, "ok": rel <= tol}
         if rel > tol:
             pf["findings"].append(_sa.Finding(
@@ -2031,6 +2517,16 @@ class ServingEngine:
         return int(sum(leaf.nbytes
                        for leaf in jax.tree_util.tree_leaves(self._cache)))
 
+    @property
+    def host_cache_bytes(self) -> int:
+        """Pinned host-RAM entitlement of the KV tier (0 without one).
+        Kept OUT of ``cache_hbm_bytes`` and the HBM-liveness
+        cross-check: swapped-out and demoted blocks are host-resident
+        by design — that is the capacity multiplier."""
+        if not self.paged:
+            return 0
+        return int(self.kv.host_cache_bytes())
+
     # -- telemetry (registry read-throughs + snapshot) ---------------------
 
     @property
@@ -2132,6 +2628,30 @@ class ServingEngine:
                 "evictions": st["evictions"],
                 "cow_copies": st["cow_copies"],
                 "admission_blocked": int(self._m_blocked.value())}
+            if self._host_blocks > 0:
+                out["kv_cache"]["host_tier"] = {
+                    "host_blocks": self._host_blocks,
+                    "host_blocks_used": self.kv.host_blocks_used(),
+                    "host_trie_blocks": self.kv.host_trie_blocks(),
+                    "host_demotions": st["host_demotions"],
+                    "host_promotions": st["host_promotions"],
+                    "swapped_out_blocks": st["swapped_out_blocks"],
+                    "swapped_in_blocks": st["swapped_in_blocks"],
+                    "swap_out_bytes": int(self._m_swap_out_bytes.value()),
+                    "swap_in_bytes": int(self._m_swap_in_bytes.value())}
+        if self.paged and self.preempt != "off":
+            def by_mode(fam):
+                return {str(c.labels["mode"]): int(c.value())
+                        for c in fam.children()
+                        if c.labels.get("engine") == self._eid}
+            out["preempt"] = {
+                "mode": self.preempt,
+                "preemptions": by_mode(self._f_preempt),
+                "resumes": by_mode(self._f_resumed),
+                "awaiting_resume": self.num_preempted,
+                "decisions": len(self._preempt_log),
+                "signature": self.preempt_signature()}
+        out["cancelled"] = int(self._m_cancelled.value())
         return out
 
     def _set_occupancy(self, n: int):
@@ -2225,19 +2745,32 @@ class ServingEngine:
         any cached prompt prefix on the way in.  A wave shares one padded
         SUFFIX bucket (prefix-hit rows only compute what the cache
         missed).  The FIFO head blocking on pool space blocks the queue —
-        head-of-line order is the contiguous engine's contract too."""
+        head-of-line order is the contiguous engine's contract too.
+
+        With preemption on, admission drains BOTH the recompute-resume
+        queue and the submit queue by priority class (stable FIFO
+        within a class — _next_admit, resume entries winning ties) and
+        a pool-full head may instead evict a running victim (see
+        _try_preempt) and retry; swapped chains are restored first of
+        all."""
+        self._service_swap_resumes()
         finished: List[int] = []
-        while self._queue:
+        while self._resume_q or self._queue:
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
                 break
             wave: List[Tuple[Request, int, int]] = []
-            while (self._queue
+            while ((self._resume_q or self._queue)
                    and len(wave) < min(self.prefill_batch, len(free))):
-                req = self._queue[0]
+                src, req = self._next_admit()
                 si = free[len(wave)]
                 m = self.kv.admit(si, req.prompt, req.prompt.size,
                                   req.max_new_tokens)
+                while m is None and self._try_preempt(
+                        priority=req.priority, rid=req.request_id,
+                        blocked_ticks=req.blocked_ticks):
+                    m = self.kv.admit(si, req.prompt, req.prompt.size,
+                                      req.max_new_tokens)
                 if m is None:          # pool full: wait for retirements
                     self._m_blocked.inc()
                     self._tracer.instant("serving.admission_blocked",
@@ -2248,7 +2781,9 @@ class ServingEngine:
                                          engine=self._eid,
                                          reason="pool_full")
                     break
-                self._queue.popleft()
+                # remove by IDENTITY: a preemption inside the retry loop
+                # may have pushed a new resume entry ahead of req
+                src.remove(req)
                 self._tables[si] = self.kv.table_row(si, self.max_blocks)
                 wave.append((req, si, m))
             if not wave:
@@ -2280,15 +2815,17 @@ class ServingEngine:
             temps[r] = req.sampling.temperature
             topk[r] = req.sampling.top_k
             topp[r] = req.sampling.top_p
-            self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
             self._m_prefill_computed.inc(int(suffix.size))
             self._m_prefill_total.inc(int(req.prompt.size))
-            req.t_admit = t_adm
-            self._rlog.event(req.uid, "admitted", engine=self._eid,
-                             slot=int(si),
-                             queue_wait_ms=(t_adm - req.t_submit) * 1e3,
-                             blocked_ticks=int(req.blocked_ticks),
-                             prefix_hit_tokens=int(m))
+            if req.resume is None:
+                self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
+                req.t_admit = t_adm
+                self._rlog.event(req.uid, "admitted", engine=self._eid,
+                                 slot=int(si),
+                                 queue_wait_ms=(t_adm - req.t_submit)
+                                 * 1e3,
+                                 blocked_ticks=int(req.blocked_ticks),
+                                 prefix_hit_tokens=int(m))
             self._rlog.event(req.uid, "prefill", engine=self._eid,
                              bucket=int(bucket),
                              tokens=int(suffix.size))
@@ -2309,23 +2846,44 @@ class ServingEngine:
         t_tok = time.perf_counter()
         finished: List[int] = []
         for r, (req, si, m) in enumerate(wave):
-            slot = _Slot(req.request_id, req.max_new_tokens - 1,
-                         t_first=t_tok, prompt=req.prompt, req=req)
+            ri = req.resume
+            if ri is not None:
+                # recompute resume: the re-sampled token re-derives the
+                # last committed one (greedy: identical); it is DISCARDED
+                # and the committed token forced back, so the resumed
+                # decode replays no token and drops none
+                first = ri.last_token
+                slot = _Slot(req.request_id, ri.remaining,
+                             t_first=ri.t_first, prompt=ri.orig.prompt,
+                             req=ri.orig)
+            else:
+                first = int(tok[r])
+                slot = _Slot(req.request_id, req.max_new_tokens - 1,
+                             t_first=t_tok, prompt=req.prompt, req=req)
             self._slots[si] = slot
             self._active[si] = True
-            self._tokens[si] = tok[r]
+            self._tokens[si] = first
             self._positions[si] = req.prompt.size
             self._temps[si] = temps[r]
             self._topk[si] = topk[r]
             self._topp[si] = topp[r]
-            self._results[req.request_id].append(int(tok[r]))
+            if ri is not None:
+                self._rlog.event(req.uid, "resumed", engine=self._eid,
+                                 mode="recompute", slot=int(si))
+                self._f_resumed.labels(engine=self._eid,
+                                       mode="recompute").inc()
+                self._tracer.instant("serving.resumed",
+                                     rid=req.request_id,
+                                     mode="recompute", slot=int(si))
+                continue
+            self._results[req.request_id].append(first)
             self._m_tokens.inc()
             self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
             if self._perf is not None:
                 self._perf.on_ttft((t_tok - req.t_submit) * 1e3)
             self._rlog.event(req.uid, "first_token", engine=self._eid,
                              ttft_ms=(t_tok - req.t_submit) * 1e3)
-            reason = self._finish_reason(int(tok[r]), slot, si)
+            reason = self._finish_reason(first, slot, si)
             if reason is not None:
                 finished.append(req.request_id)
                 self._retire(slot, si, reason, t_tok)
@@ -2413,6 +2971,14 @@ class ServingEngine:
     def _release(self, i: int):
         if self.paged:
             self.kv.release(i)
+        self._clear_slot(i)
+
+    def _clear_slot(self, i: int):
+        """Reset slot ``i``'s host mirrors WITHOUT touching the block
+        pool — preemption already moved/freed the chain through
+        ``swap_out``/``preempt_free``; ``_release`` adds the
+        ``kv.release`` for normal retirement."""
+        if self.paged:
             self._tables[i] = 0
         self._slots[i] = None
         self._active[i] = False

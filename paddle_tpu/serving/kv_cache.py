@@ -77,6 +77,36 @@ every block it could ever need (prompt + max_new_tokens, minus the shared
 prefix); the reservation is consumed block-by-block as the sequence
 deepens and released with the slot.  There is no fragmentation (any free
 block serves any slot), so the check is exact.
+
+Tiering (ISSUE 16): ``host_blocks > 0`` arms a second, host-RAM tier — a
+:class:`HostTier` pool of pinned host buffers the same block geometry as
+the device pool.  Two flows feed it, both pure host-side bookkeeping plus
+one device copy the engine performs through the ``on_swap_out`` /
+``on_swap_in`` hooks (exactly the ``on_demote`` pattern the mixed-mode
+int8 demotion already uses):
+
+  * **demote-on-evict**: when pool pressure would DROP the LRU head's
+    content, the block instead demotes HBM→host — its payload moves to a
+    host buffer and its full TOKEN PATH (the tuple of per-block token
+    tuples from the prompt root) keys a host-side trie.  A later
+    admission whose prompt walk runs off the end of the device trie
+    continues into the host trie and PROMOTES each hit: a fresh device
+    block is allocated from the request's reservation, the payload is
+    copied back, and the block re-registers in the device trie — so the
+    prefix cache's effective capacity is host-RAM-sized, not HBM-sized.
+    Token paths key the host trie (not parent block ids) because the
+    physical parent id dies at demotion; a path is in AT MOST ONE tier
+    at a time, and unreachable host entries (an ancestor dropped from
+    both tries) are cascade-freed exactly like the device trie's;
+  * **swap-out** (preemption): :meth:`swap_out` tears down a victim
+    slot's allocation — private blocks (refcount 1) move payload+dtype
+    to PINNED host buffers recorded in a resume record, shared blocks
+    keep this slot's reference so the chain survives other owners'
+    releases — and :meth:`resume_swapped` rebuilds the chain later.
+    Record entries are keyed by host id, never by token path: a swapped
+    chain can NEVER serve a prefix hit until promoted back.  Pinned
+    buffers are not evictable; demoted trie entries are (LRU), so swap
+    capacity always wins over cached-prefix capacity.
 """
 
 from __future__ import annotations
@@ -90,7 +120,7 @@ import numpy as np
 
 from .. import observability as _obs
 
-__all__ = ["BlockManager", "NULL_BLOCK", "init_paged_kv_cache"]
+__all__ = ["BlockManager", "HostTier", "NULL_BLOCK", "init_paged_kv_cache"]
 
 NULL_BLOCK = 0          # physical block 0: pad/dummy scratch, never allocated
 _ROOT = -1              # trie parent id of a prompt's first block
@@ -109,7 +139,8 @@ class _StatsView(Mapping):
 
     _KEYS = ("prefix_lookups", "prefix_hit_blocks", "prefix_hit_tokens",
              "evictions", "cow_copies", "peak_blocks_in_use",
-             "quantized_blocks")
+             "quantized_blocks", "host_demotions", "host_promotions",
+             "swapped_out_blocks", "swapped_in_blocks")
 
     def __init__(self, mgr: "BlockManager"):
         self._mgr = mgr
@@ -167,6 +198,61 @@ class _SlotAlloc:
         self.reserved_left = reserved_left
 
 
+# a block's full token path from the prompt root: one tuple of tokens
+# per block, root first — the tier-stable identity of its contents
+_Path = Tuple[Tuple[int, ...], ...]
+
+
+class HostTier:
+    """Pinned host-RAM block pool — the HBM pool's second tier.
+
+    Capacity is counted in blocks of the SAME geometry as the device
+    pool; each live host id owns one block-shaped payload (a host numpy
+    pytree the engine reads off / writes back to the device through the
+    manager's ``on_swap_out`` / ``on_swap_in`` hooks).  The tier itself
+    is a dumb id allocator + payload store: WHICH ids are evictable
+    (demoted prefix-trie blocks) versus pinned (preemption swap records)
+    is the :class:`BlockManager`'s call — it only ever reclaims trie
+    ids, so this class never evicts on its own and ``alloc()`` on a full
+    tier is a caller bug."""
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ids = itertools.count()
+        self._live: Set[int] = set()
+        self._payload: Dict[int, object] = {}
+
+    @property
+    def used(self) -> int:
+        return len(self._live)
+
+    def free_slots(self) -> int:
+        return self.capacity - len(self._live)
+
+    def alloc(self) -> int:
+        if len(self._live) >= self.capacity:
+            raise RuntimeError(
+                "host tier full (BlockManager must make room before "
+                "allocating)")
+        hid = next(self._ids)
+        self._live.add(hid)
+        return hid
+
+    def put(self, hid: int, payload) -> None:
+        if hid not in self._live:
+            raise KeyError(f"host id {hid} is not allocated")
+        self._payload[hid] = payload
+
+    def get(self, hid: int):
+        return self._payload[hid]
+
+    def free(self, hid: int) -> None:
+        self._live.remove(hid)
+        self._payload.pop(hid, None)
+
+
 class BlockManager:
     """Host-side allocator for a pool of ``num_blocks`` KV blocks of
     ``block_len`` tokens (block 0 reserved as the null block).
@@ -179,7 +265,8 @@ class BlockManager:
     """
 
     def __init__(self, num_blocks: int, block_len: int,
-                 prefix_cache: bool = True, kv_dtype: str = "bf16"):
+                 prefix_cache: bool = True, kv_dtype: str = "bf16",
+                 host_blocks: int = 0):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the null block), "
@@ -206,6 +293,25 @@ class BlockManager:
         # once per demotion, COW/refcount-safe because registration only
         # covers immutable full prompt blocks
         self.on_demote = None
+        # host-tier hooks, same pattern as on_demote: the engine copies
+        # device block contents off to / back from host payloads.  Fired
+        # with [(device_bid, host_id)] pairs (swap-out / demote) or
+        # [(host_id, device_bid)] pairs (swap-in / promote), always
+        # BEFORE the device block id can be handed to a new owner, so
+        # the copy is ordered against any later dispatch by host program
+        # order.
+        self.on_swap_out = None
+        self.on_swap_in = None
+        self._host: Optional[HostTier] = (
+            HostTier(host_blocks) if host_blocks > 0 else None)
+        # host-side trie: full token path -> (host id, element dtype).
+        # OrderedDict insertion order IS the host LRU (oldest demotion
+        # evicted first when swap records need the room).
+        self._host_trie: "OrderedDict[_Path, Tuple[int, str]]" = (
+            OrderedDict())
+        # device block id -> its full token path while trie-registered
+        # (what survives demotion as the host-trie key)
+        self._block_path: Dict[int, _Path] = {}
         # bytes per block, per element dtype — set by the engine (the
         # manager has no model dims); feeds kv_cache.bytes_by_dtype
         self._block_nbytes: Dict[str, int] = {}
@@ -249,6 +355,22 @@ class BlockManager:
             "cow_copies": reg.counter(
                 "kv_cache.cow_copies",
                 "ensure_writable copy-on-write copies").labels(**lbl),
+            "host_demotions": reg.counter(
+                "kv_cache.host_demotions",
+                "cold prefix blocks demoted HBM -> host instead of "
+                "dropped under pool pressure").labels(**lbl),
+            "host_promotions": reg.counter(
+                "kv_cache.host_promotions",
+                "host-tier prefix blocks promoted back to HBM on an "
+                "admission hit").labels(**lbl),
+            "swapped_out_blocks": reg.counter(
+                "kv_cache.swapped_out_blocks",
+                "private blocks moved to pinned host buffers by "
+                "preemption swap-out").labels(**lbl),
+            "swapped_in_blocks": reg.counter(
+                "kv_cache.swapped_in_blocks",
+                "pinned host blocks restored to HBM by preemption "
+                "resume").labels(**lbl),
         }
         self._peak = 0
         self._g_peak = reg.gauge(
@@ -270,6 +392,14 @@ class BlockManager:
             "kv_cache.quantized_blocks",
             "live (referenced or LRU-cached) blocks holding int8 "
             "content").labels(**lbl)
+        self._g_host_used = reg.gauge(
+            "kv_cache.host_blocks_used",
+            "host-tier blocks live (demoted trie blocks + pinned swap "
+            "records)").labels(**lbl)
+        self._g_host_trie = reg.gauge(
+            "kv_cache.host_trie_blocks",
+            "host-tier blocks holding demoted (promotable, evictable) "
+            "prefix-trie content").labels(**lbl)
         self._f_bytes = reg.gauge(
             "kv_cache.bytes_by_dtype",
             "live pool bytes per element dtype (payload + scale share; "
@@ -367,16 +497,33 @@ class BlockManager:
         bl = self.block_len
         prompt = [int(t) for t in prompt[:prompt_len]]
         matched: List[int] = []
+        path: _Path = ()
+        promo: List[Tuple[_Path, Tuple[int, ...], Tuple[int, str]]] = []
         if self.prefix_cache:
             self._counters["prefix_lookups"].inc()
             parent = _ROOT
-            for b in range((prompt_len - 1) // bl):
-                bid = self._trie.get((parent, tuple(prompt[b * bl:
-                                                          (b + 1) * bl])))
+            cap = (prompt_len - 1) // bl
+            for b in range(cap):
+                toks = tuple(prompt[b * bl:(b + 1) * bl])
+                bid = self._trie.get((parent, toks))
                 if bid is None:
                     break
+                path = path + (toks,)
                 matched.append(bid)
                 parent = bid
+            # the walk continues into the HOST tier: demoted blocks whose
+            # full token path extends the device match are promotion
+            # candidates (allocated below, from this request's own
+            # reservation — they count as unmatched for admission math)
+            if self._host is not None:
+                for b in range(len(matched), cap):
+                    toks = tuple(prompt[b * bl:(b + 1) * bl])
+                    p = path + (toks,)
+                    ent = self._host_trie.get(p)
+                    if ent is None:
+                        break
+                    promo.append((p, toks, ent))
+                    path = p
         m = len(matched)
         total = self.blocks_needed(prompt_len, max_new_tokens)
         need = total - m
@@ -392,18 +539,56 @@ class BlockManager:
         st = _SlotAlloc(list(matched), need)
         self._slots[slot] = st
         self._reserved += need
+        if promo:
+            # promote host hits: fresh device blocks (reservation-funded,
+            # so allocation cannot fail), payload copied back by the
+            # engine's on_swap_in, re-registered in the device trie under
+            # their original keys.  NOT _fresh: swap-in restores content
+            # AND scale — the int8 engine's fresh-scale zeroing would
+            # wipe the restored quantization scale.
+            #
+            # Claim the host entries FIRST: _append_block below may have
+            # to evict (_evict_one), whose demotion path calls
+            # _host_make_room / _host_drop_cascade — either could evict a
+            # still-listed promo entry, freeing the very payload we are
+            # about to copy back (and the later trie delete would then
+            # KeyError).  Popped entries keep their host ids allocated,
+            # so they are invisible to host eviction but their payloads
+            # stay live until on_swap_in has read them.
+            for p, _, _ in promo:
+                del self._host_trie[p]
+            pairs: List[Tuple[int, int]] = []
+            parent = matched[-1] if matched else _ROOT
+            for p, toks, (hid, dt) in promo:
+                bid = self._append_block(st)
+                self._fresh.discard(bid)
+                self._dtype[bid] = 1 if dt == "int8" else 0
+                key = (parent, toks)
+                self._trie[key] = bid
+                self._block_key[bid] = key
+                self._block_path[bid] = p
+                if parent != _ROOT:
+                    self._children.setdefault(parent, set()).add(bid)
+                pairs.append((hid, bid))
+                parent = bid
+            if self.on_swap_in is not None:
+                self.on_swap_in(list(pairs))
+            for hid, _ in pairs:
+                self._host.free(hid)
+            self._counters["host_promotions"].inc(len(pairs))
+        m_blocks = m + len(promo)
         if not chunked:
             # blocks covering positions [0, prompt_len]: the prefill
             # writes the suffix and the first decode step writes position
             # prompt_len
-            for _ in range(prompt_len // bl + 1 - m):
+            for _ in range(prompt_len // bl + 1 - m_blocks):
                 self._append_block(st)
             if self.prefix_cache:
                 self._register_prompt(st.chain, prompt, prompt_len)
-        self._counters["prefix_hit_blocks"].inc(m)
-        self._counters["prefix_hit_tokens"].inc(m * bl)
+        self._counters["prefix_hit_blocks"].inc(m_blocks)
+        self._counters["prefix_hit_tokens"].inc(m_blocks * bl)
         self._note_peak()
-        return m * bl
+        return m_blocks * bl
 
     def prefix_probe(self, prompt: Sequence[int],
                      prompt_len: Optional[int] = None) -> int:
@@ -452,15 +637,27 @@ class BlockManager:
         decode and must stay private."""
         bl = self.block_len
         parent = _ROOT
+        path: _Path = ()
         demoted: List[int] = []
         for b in range(prompt_len // bl):
             bid = chain[b]
-            key = (parent, tuple(prompt[b * bl:(b + 1) * bl]))
+            toks = tuple(prompt[b * bl:(b + 1) * bl])
+            key = (parent, toks)
+            path = path + (toks,)
             if key not in self._trie and bid not in self._block_key:
                 self._trie[key] = bid
                 self._block_key[bid] = key
+                self._block_path[bid] = path
                 if parent != _ROOT:
                     self._children.setdefault(parent, set()).add(bid)
+                # one-tier rule: this path now has freshly written HBM
+                # content, so a host-demoted copy of the same path is
+                # redundant — drop it (content-identical by definition:
+                # the path IS the content identity)
+                if self._host is not None:
+                    ent = self._host_trie.pop(path, None)
+                    if ent is not None:
+                        self._host.free(ent[0])
                 # mixed pool: a block registering as a shareable FULL
                 # prefix block is cold by definition (immutable from
                 # here on) — demote it to int8 now; the engine's
@@ -559,6 +756,181 @@ class BlockManager:
                     self._dtype[bid] = self._default_dtype
         self._refresh_gauges()
 
+    # -- preemption / host tier --------------------------------------------
+
+    @property
+    def host_tier(self) -> Optional[HostTier]:
+        """The host-RAM tier (None when ``host_blocks == 0``) — the
+        engine reads/writes payloads through it from the swap hooks."""
+        return self._host
+
+    def host_blocks_used(self) -> int:
+        return self._host.used if self._host is not None else 0
+
+    def host_trie_blocks(self) -> int:
+        """Host-tier blocks holding demoted (promotable) trie content;
+        the rest of ``host_blocks_used`` is pinned swap records."""
+        return len(self._host_trie)
+
+    def host_cache_bytes(self) -> int:
+        """Host-RAM entitlement of the tier: capacity x full-precision
+        block bytes (payloads are per-entry dtype, so this is the
+        worst case).  Deliberately NOT part of ``cache_hbm_bytes`` or
+        the mesh pre-flight HBM-liveness cross-check — the tier lives
+        in pinned host memory, never on device."""
+        if self._host is None or not self._block_nbytes:
+            return 0
+        return self._host.capacity * self._block_nbytes.get("bf16", 0)
+
+    def private_swap_blocks(self, slot: int) -> int:
+        """How many of ``slot``'s blocks a swap-out would have to move
+        to the host tier (refcount-1 blocks; shared blocks stay put)."""
+        st = self._slots[slot]
+        return sum(1 for bid in st.chain if self._ref[bid] == 1)
+
+    def host_can_accept(self, n: int) -> bool:
+        """Could the host tier take ``n`` more pinned blocks right now,
+        evicting demoted trie entries if it must?  (Pinned swap records
+        are never evicted for other swap records.)"""
+        if self._host is None:
+            return False
+        return self._host.free_slots() + len(self._host_trie) >= n
+
+    def _host_make_room(self, n: int) -> bool:
+        """Ensure ``n`` free host slots by evicting the oldest demoted
+        trie entries (never pinned swap records).  False when the tier
+        cannot cover ``n`` — nothing is evicted needlessly first."""
+        if self._host is None:
+            return False
+        if self._host.free_slots() + len(self._host_trie) < n:
+            return False
+        while self._host.free_slots() < n:
+            p, (hid, _) = self._host_trie.popitem(last=False)
+            self._host.free(hid)
+            self._host_drop_cascade(p)
+        return True
+
+    def _host_drop_cascade(self, path: _Path):
+        """Free host-trie entries STRICTLY below ``path`` — with their
+        ancestor gone from both tiers the admission walk can never
+        reach them, and unreachable entries would leak host capacity."""
+        if self._host is None or not self._host_trie:
+            return
+        k = len(path)
+        for p in [p for p in self._host_trie
+                  if len(p) > k and p[:k] == path]:
+            hid, _ = self._host_trie.pop(p)
+            self._host.free(hid)
+
+    def swap_out(self, slot: int) -> Optional[Dict[str, object]]:
+        """Preempt ``slot``: tear down its allocation, moving every
+        PRIVATE block (refcount 1) to a pinned host buffer and keeping
+        this slot's reference on every SHARED block so the chain
+        survives other owners' releases.  Returns the resume record for
+        :meth:`resume_swapped` — ``entries`` is the chain in order, each
+        entry ``("hbm", bid)`` (reference kept) or ``("host", hid,
+        dtype)`` (payload pinned on host) — or ``None`` when the host
+        tier cannot take the private blocks even after evicting every
+        demoted trie entry (caller falls back to recompute or skips the
+        victim).  Record entries are never trie keys: a swapped chain
+        cannot serve a prefix hit until it is resumed."""
+        st = self._slots[slot]
+        n_priv = sum(1 for bid in st.chain if self._ref[bid] == 1)
+        if not self._host_make_room(n_priv):
+            return None
+        st = self._slots.pop(slot)
+        reserved_left = st.reserved_left
+        self._reserved -= reserved_left
+        entries: List[Tuple] = []
+        pairs: List[Tuple[int, int]] = []
+        for bid in st.chain:
+            if self._ref[bid] > 1:
+                entries.append(("hbm", int(bid)))
+                continue
+            if bid in self._block_key:
+                # the physical id is about to be freed — its trie entry
+                # (and descendants') would dangle
+                self._unregister_cascade(bid)
+            hid = self._host.alloc()
+            entries.append(("host", hid, self.block_dtype(bid)))
+            pairs.append((int(bid), hid))
+            self._ref[bid] = 0
+            self._free.append(bid)
+            self._dtype[bid] = self._default_dtype
+        if pairs:
+            if self.on_swap_out is not None:
+                self.on_swap_out(list(pairs))
+            self._counters["swapped_out_blocks"].inc(len(pairs))
+        self._fresh.difference_update(b for b, _ in pairs)
+        self._refresh_gauges()
+        return {"entries": entries, "reserved_left": int(reserved_left)}
+
+    def resume_swapped(self, slot: int, record: Dict[str, object]
+                       ) -> Optional[int]:
+        """Rebuild a swapped-out chain into (free) ``slot``: allocate a
+        fresh device block per ``host`` entry (payload copied back via
+        ``on_swap_in``, host buffer freed), re-adopt each ``hbm`` entry
+        (its reference was never dropped), and re-arm the remaining
+        reservation.  Returns the chain length, or ``None`` when the
+        pool cannot cover the host blocks + reservation yet (caller
+        keeps the record and retries later)."""
+        if slot in self._slots:
+            raise ValueError(f"slot {slot} already has an allocation")
+        entries = record["entries"]
+        reserved = int(record["reserved_left"])
+        n_host = sum(1 for e in entries if e[0] == "host")
+        if self._available() < n_host + reserved:
+            return None
+        chain: List[int] = []
+        pairs: List[Tuple[int, int]] = []
+        for e in entries:
+            if e[0] == "hbm":
+                chain.append(int(e[1]))
+                continue
+            _, hid, dt = e
+            bid = self._pop_block()
+            self._ref[bid] = 1
+            self._dtype[bid] = 1 if dt == "int8" else 0
+            chain.append(bid)
+            pairs.append((hid, int(bid)))
+        self._slots[slot] = _SlotAlloc(chain, reserved)
+        self._reserved += reserved
+        if pairs:
+            if self.on_swap_in is not None:
+                self.on_swap_in(list(pairs))
+            for hid, _ in pairs:
+                self._host.free(hid)
+            self._counters["swapped_in_blocks"].inc(len(pairs))
+        self._note_peak()
+        return len(chain)
+
+    def drop_swap_record(self, record: Dict[str, object]):
+        """Cancel a swapped-out request: release the record's pinned
+        host buffers and drop the references it kept on shared blocks
+        (parking registered ones on the LRU exactly like a release)."""
+        for e in record["entries"]:
+            if e[0] == "hbm":
+                bid = int(e[1])
+                self._ref[bid] -= 1
+                if self._ref[bid] == 0:
+                    if bid in self._block_key:
+                        self._lru[bid] = None
+                        self._lru.move_to_end(bid)
+                    else:
+                        self._free.append(bid)
+                        self._dtype[bid] = self._default_dtype
+            else:
+                self._host.free(e[1])
+        self._refresh_gauges()
+
+    def preempt_free(self, slot: int):
+        """Recompute-mode preemption: pool mechanics identical to
+        :meth:`release` — registered prompt blocks park on the LRU, so
+        the victim's resume re-prefill adopts whatever survives the
+        pressure through the ordinary prefix-trie path (possibly via
+        the host tier if it demotes in between)."""
+        self.release(slot)
+
     def _evict_one(self) -> int:
         """Reclaim the LRU cached block.  Unregistering cascades through
         the block's trie descendants (their chain keys dangle once the
@@ -570,6 +942,19 @@ class BlockManager:
                 "(reservation accounting should have prevented this)")
         bid, _ = self._lru.popitem(last=False)
         self._counters["evictions"].inc()
+        # tiering: instead of dropping the content, demote it HBM ->
+        # host (payload copied off by the engine BEFORE the id can be
+        # handed to a new owner; the full token path keys the host trie
+        # so a later admission can promote it back).  Skipped when the
+        # host tier is absent or full of pinned swap records.
+        bpath = self._block_path.get(bid)
+        if (self._host is not None and bpath is not None
+                and self._host_make_room(1)):
+            hid = self._host.alloc()
+            if self.on_swap_out is not None:
+                self.on_swap_out([(int(bid), hid)])
+            self._host_trie[bpath] = (hid, self.block_dtype(bid))
+            self._counters["host_demotions"].inc()
         self._unregister_cascade(bid)
         self._dtype[bid] = self._default_dtype  # new owner rewrites it
         return bid
@@ -586,6 +971,15 @@ class BlockManager:
             key = self._block_key.pop(b, None)
             if key is not None:
                 self._trie.pop(key, None)
+            bpath = self._block_path.pop(b, None)
+            if bpath is not None and self._host is not None:
+                # host entries STRICTLY below this path lose their last
+                # ancestor link — the admission walk can never reach
+                # them again, so they are dropped like device-trie
+                # descendants (the demoted copy AT b's own path, if the
+                # eviction above just created it, survives: strict
+                # descendants only)
+                self._host_drop_cascade(bpath)
             stack.extend(self._children.pop(b, ()))
             if b != bid and b in self._lru:
                 del self._lru[b]
@@ -691,6 +1085,9 @@ class BlockManager:
         live = self._live_mask()
         n_int8 = int((live & (self._dtype == 1)).sum())
         self._g_quant.set(n_int8)
+        if self._host is not None:
+            self._g_host_used.set(self._host.used)
+            self._g_host_trie.set(len(self._host_trie))
         if self._block_nbytes:
             self._g_bytes["int8"].set(
                 n_int8 * self._block_nbytes.get("int8", 0))

@@ -177,7 +177,7 @@ def replay(target, load: Sequence[LoadRequest],
 
     def busy() -> bool:
         return any(e.queue_depth or e.num_active or e.num_pending
-                   for e in engines)
+                   or getattr(e, "num_preempted", 0) for e in engines)
 
     order = sorted(range(len(load)),
                    key=lambda i: (load[i].arrival, load[i].index))
@@ -234,10 +234,11 @@ def replay(target, load: Sequence[LoadRequest],
 
 def _smoke() -> int:
     """Tiny seeded load against the engine modes CI guards (wave,
-    chunked, paged int8-KV), each replayed twice on fresh engines:
-    non-zero exit on a step retrace past budget 1 or on any determinism
-    drift (signature or sampled outputs) between the identical-seed
-    runs."""
+    chunked, paged int8-KV, preempt-saturated), each replayed twice on
+    fresh engines: non-zero exit on a step retrace past budget 1, any
+    determinism drift (signature, sampled outputs, or — saturated —
+    the preemption-decision signature) between the identical-seed
+    runs, or any graph/kernel-lint finding."""
     import json
 
     import jax
@@ -265,26 +266,44 @@ def _smoke() -> int:
              # replay so a regression in the quantize-at-scatter /
              # dequant-in-kernel path fails CI, not just the bench
              "int8_paged": {"paged": True, "block_len": 16,
-                            "kv_cache_dtype": "int8"}}
+                            "kv_cache_dtype": "int8"},
+             # preemption canary (ISSUE 16): a pool too tight for the
+             # trace to fit resident, so the preemptive scheduler must
+             # evict mid-decode and swap back in via the host tier —
+             # gated below on preemptions actually firing and on the
+             # victim-decision signature replaying byte-stable
+             "saturated": {"paged": True, "block_len": 8,
+                           "num_blocks": 12, "preempt": "swap",
+                           "host_blocks": 32}}
     failures: List[str] = []
     summary: Dict[str, Any] = {"requests": spec.n_requests}
     for mode, kw in modes.items():
         runs = []
         kernel_findings = -1
+        preempt_sigs: List[str] = []
+        preemptions: List[int] = []
         for _ in range(2):
             eng = ServingEngine(model, num_slots=4, max_length=128,
                                 prefill_batch=2, **kw)
             if kernel_findings < 0:
                 # ISSUE 14 CI gate: the kernels this mode's dispatch
                 # would select must pre-flight clean (static — no
-                # compile), so a kernel-lint regression fails the smoke
-                kf = eng.kernel_preflight()["findings"]
+                # compile), so a kernel-lint regression fails the smoke.
+                # The saturated mode runs the FULL merged lint
+                # (graph rules + kernel pre-flight) — the ISSUE 16
+                # contract is zero findings of either kind
+                kf = (eng.lint_step() if mode == "saturated"
+                      else eng.kernel_preflight()["findings"])
                 kernel_findings = len(kf)
                 if kf:
                     failures.append(
-                        f"{mode}: kernel pre-flight findings: "
+                        f"{mode}: pre-flight findings: "
                         + "; ".join(str(f) for f in kf))
             runs.append(replay(eng, load))
+            if mode == "saturated":
+                preempt_sigs.append(eng.preempt_signature())
+                preemptions.append(sum(
+                    eng.metrics()["preempt"]["preemptions"].values()))
         a, b = runs
         traces = max(max(r["step_traces"]) for r in runs)
         if traces > 1:
@@ -317,6 +336,17 @@ def _smoke() -> int:
         if len(set(perf_sigs)) > 1:
             failures.append(f"{mode}: perf_report predicted-side drift "
                             f"between identical-seed runs")
+        if mode == "saturated":
+            # the mode only tests anything if the pool actually forced
+            # eviction, and the victim decisions must replay byte-stable
+            if not all(preemptions):
+                failures.append(
+                    "saturated: tight pool produced no preemption — "
+                    "the mode is not exercising the scheduler")
+            if len(set(preempt_sigs)) > 1:
+                failures.append(
+                    "saturated: preemption-decision signature drift "
+                    "between identical-seed runs")
         summary[mode] = {
             "ticks": a["ticks"],
             "generated_tokens": a["generated_tokens"],
@@ -328,6 +358,10 @@ def _smoke() -> int:
             "perf_deterministic": len(set(perf_sigs)) <= 1,
             "deterministic": (a["signature"] == b["signature"]
                               and a["outputs"] == b["outputs"])}
+        if mode == "saturated":
+            summary[mode]["preemptions"] = preemptions
+            summary[mode]["preempt_signature_stable"] = (
+                len(set(preempt_sigs)) <= 1)
     summary["failures"] = failures
     print(json.dumps(summary, indent=2))
     return 1 if failures else 0

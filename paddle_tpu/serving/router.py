@@ -174,10 +174,12 @@ class ReplicaRouter:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                sampling: Optional[SamplingParams] = None,
-               session=None) -> int:
+               session=None, priority: int = 0) -> int:
         """Place and enqueue a request; returns the ROUTER request id.
         ``session`` (any hashable) pins this and every later request of
-        the session to one replica — decode never migrates."""
+        the session to one replica — decode never migrates.
+        ``priority`` rides through to the replica's preemptive scheduler
+        (higher wins a victim slot under saturation)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         # the lifecycle uid is minted HERE, before placement, and the
         # same uid rides through every replica attempt — on failover the
@@ -195,7 +197,8 @@ class ReplicaRouter:
             try:
                 erid = self.engines[i].submit(
                     prompt, max_new_tokens=max_new_tokens,
-                    sampling=sampling, request_uid=uid)
+                    sampling=sampling, request_uid=uid,
+                    priority=priority)
             except ValueError as e:
                 # admission rejected the request outright (e.g. the
                 # replica's pool cannot cover its worst case) — the
@@ -224,6 +227,18 @@ class ReplicaRouter:
         into the request log across every replica the request touched."""
         return self._uids[rid]
 
+    def cancel(self, rid: int) -> bool:
+        """Cancel router request ``rid`` wherever its replica holds it
+        (queued, mid-prefill, decoding, or awaiting resume after a
+        preemption).  Delegates to the owning replica's
+        :meth:`ServingEngine.cancel`; returns ``False`` once the
+        request already finished (its tokens stay retrievable via
+        :meth:`result`)."""
+        if rid not in self._placed:
+            raise KeyError(f"unknown router request id {rid}")
+        i, erid = self._placed[rid]
+        return self.engines[i].cancel(erid)
+
     # -- scheduling --------------------------------------------------------
 
     def step(self) -> List[int]:
@@ -242,7 +257,7 @@ class ReplicaRouter:
         """Tick until every replica is empty; returns
         ``[(router_rid, tokens)]`` in arrival order."""
         while any(eng.queue_depth or eng.num_active or eng.num_pending
-                  for eng in self.engines):
+                  or eng.num_preempted for eng in self.engines):
             self.step()
         return [(rid, self.result(rid)) for rid in self._placed]
 

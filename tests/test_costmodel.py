@@ -39,7 +39,8 @@ def test_profiles_and_resolution():
 def test_profile_as_dict_round_trips():
     d = cm.PROFILES["v5e"].as_dict()
     assert d == {"name": "v5e", "peak_bf16_flops": 197e12,
-                 "hbm_gbps": 675.0, "ici_gbps": 200.0}
+                 "hbm_gbps": 675.0, "ici_gbps": 200.0,
+                 "host_gbps": 16.0}
     assert cm.HardwareProfile(**d) == cm.PROFILES["v5e"]
 
 

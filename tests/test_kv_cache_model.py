@@ -1,8 +1,10 @@
-"""Exhaustive small-scope BlockManager state-machine checker (ISSUE 14).
+"""Exhaustive small-scope BlockManager state-machine checker (ISSUE 14;
+preemption/tiering ops ISSUE 16).
 
 Runs ALL interleavings of {admit, ensure_capacity, cow_write,
-truncate_to, demote, evict, release} up to depth 6 on a tiny pool
-(4 usable blocks, block_len 2) against an independent reference model,
+truncate_to, demote, evict, release, swap_out, swap_in, preempt_free}
+up to depth 6 on a tiny pool (4 usable blocks, block_len 2; host tier
+of 3 in the tiered variant) against an independent reference model,
 and asserts the allocator's structural invariants after EVERY step:
 
   I1  partition — every usable block is exactly one of {free-list,
@@ -19,7 +21,13 @@ and asserts the allocator's structural invariants after EVERY step:
   I6  null-block aliasing — no live chain contains NULL_BLOCK, and
       ``table_row`` round-trips (chain prefix verbatim, null-filled
       tail) — the host half of the decode kernel's dead-tail clamp
-      contract.
+      contract;
+  I7  tiers — a token path lives in exactly ONE tier (device trie and
+      host trie are disjoint), host-tier occupancy equals demoted trie
+      entries + pinned swap-record blocks, the host LRU order matches
+      the model's, and an outstanding swap record's pinned host ids
+      never appear in the host trie — a swapped-out chain can never
+      serve a prefix hit until it is resumed and re-registered.
 
 The reference model (:class:`RefPool`) re-implements the DOCUMENTED
 semantics over abstract entries (no physical ids — trie identity is the
@@ -33,6 +41,8 @@ chain, so eviction cascades with live and parked descendants are inside
 the explored space, not just the directed tests.
 """
 
+from collections import OrderedDict
+
 import numpy as np
 import pytest
 
@@ -41,6 +51,7 @@ from paddle_tpu.serving.kv_cache import NULL_BLOCK, BlockManager
 
 BL = 2            # tokens per block
 NUM_BLOCKS = 5    # 4 usable + the null block
+HOST_BLOCKS = 3   # host-tier capacity in the tiered sweep variant
 DEPTH = 6
 _ROOT_PATH = ()
 
@@ -76,7 +87,7 @@ class _Entry:
 class RefPool:
     """Reference model of BlockManager's documented semantics."""
 
-    def __init__(self, kv_dtype):
+    def __init__(self, kv_dtype, host_blocks=0):
         self.kv_dtype = kv_dtype
         self.default_dtype = "int8" if kv_dtype == "int8" else "bf16"
         self.free = NUM_BLOCKS - 1
@@ -87,13 +98,31 @@ class RefPool:
         self.evictions = 0
         self.cow_copies = 0
         self.hit_tokens = 0
+        # host tier (ISSUE 16): demoted trie content keyed by path
+        # (insertion order IS the host LRU) plus a pinned-block count
+        # for the outstanding swap record
+        self.host_cap = host_blocks
+        self.host_trie = OrderedDict()     # path -> dtype
+        self.host_pinned = 0
+        self.swap_record = None            # model-side record
+        self.real_record = None            # the implementation's record
+        self.host_demotions = 0
+        self.host_promotions = 0
+        self.swapped_out = 0
+        self.swapped_in = 0
 
     # -- helpers ----------------------------------------------------------
 
     def live_entries(self):
         seen = []
-        for st in self.slots.values():
-            for e in st["chain"]:
+        chains = [st["chain"] for st in self.slots.values()]
+        if self.swap_record is not None:
+            # a swap record's shared entries keep their reference —
+            # those blocks stay "in use" while the victim is out
+            chains.append([e[1] for e in self.swap_record["entries"]
+                           if e[0] == "hbm"])
+        for chain in chains:
+            for e in chain:
                 if e not in seen:
                     seen.append(e)
         return seen
@@ -104,12 +133,36 @@ class RefPool:
     def pool_nonempty(self):
         return self.free > 0 or len(self.lru) > 0
 
+    def host_free_slots(self):
+        return self.host_cap - self.host_pinned - len(self.host_trie)
+
+    def _host_drop_cascade(self, path):
+        # host entries STRICTLY below ``path`` lose their ancestor link
+        k = len(path)
+        for p in [p for p in self.host_trie
+                  if len(p) > k and p[:k] == path]:
+            del self.host_trie[p]
+
+    def _host_make_room(self, n):
+        if self.host_free_slots() + len(self.host_trie) < n:
+            return False
+        while self.host_free_slots() < n:
+            p, _ = self.host_trie.popitem(last=False)
+            self._host_drop_cascade(p)
+        return True
+
     def _pop_block(self):
         if self.free > 0:
             self.free -= 1
             return _Entry(self.default_dtype)
         e = self.lru.pop(0)
         self.evictions += 1
+        # tiering: the evicted block's content demotes to the host trie
+        # when the tier has (or can make) room
+        if (self.host_cap and e.path is not None
+                and self._host_make_room(1)):
+            self.host_trie[e.path] = e.dtype
+            self.host_demotions += 1
         self._unregister_cascade(e)
         e.dtype = self.default_dtype
         return e
@@ -118,6 +171,7 @@ class RefPool:
         if root.path is None:
             return
         prefix = root.path
+        self._host_drop_cascade(prefix)
         for path in [p for p in self.registered
                      if p[:len(prefix)] == prefix]:
             e = self.registered.pop(path)
@@ -145,6 +199,9 @@ class RefPool:
             if path not in self.registered and e.path is None:
                 self.registered[path] = e
                 e.path = path
+                # one-tier rule: fresh HBM content at this path makes a
+                # host-demoted copy redundant
+                self.host_trie.pop(path, None)
                 if self.kv_dtype == "mixed" and e.dtype == "bf16":
                     e.dtype = "int8"
             parent = path
@@ -155,13 +212,26 @@ class RefPool:
         prompt, plen, max_new, chunked = SLOT_CFG[slot]
         matched = []
         parent = _ROOT_PATH
-        for b in range((plen - 1) // BL):
+        cap = (plen - 1) // BL
+        for b in range(cap):
             path = parent + (tuple(prompt[b * BL:(b + 1) * BL]),)
             e = self.registered.get(path)
             if e is None:
                 break
             matched.append(e)
             parent = path
+        # the walk continues into the host tier: demoted paths extending
+        # the device match are promotion candidates (reservation-funded,
+        # so they count as unmatched for the admission math)
+        promo = []
+        if self.host_cap:
+            for b in range(len(matched), cap):
+                path = parent + (tuple(prompt[b * BL:(b + 1) * BL]),)
+                dt = self.host_trie.get(path)
+                if dt is None:
+                    break
+                promo.append((path, dt))
+                parent = path
         m = len(matched)
         total = -(-(plen + max_new) // BL)
         need = total - m
@@ -174,12 +244,21 @@ class RefPool:
             e.refs += 1
         self.slots[slot] = {"chain": list(matched), "left": need}
         self.reserved += need
+        for path, dt in promo:
+            self._append_block(slot)
+            e = self.slots[slot]["chain"][-1]
+            e.dtype = dt
+            e.path = path
+            self.registered[path] = e
+            del self.host_trie[path]
+            self.host_promotions += 1
+        m_blocks = m + len(promo)
         if not chunked:
-            for _ in range(plen // BL + 1 - m):
+            for _ in range(plen // BL + 1 - m_blocks):
                 self._append_block(slot)
             self._register_prompt(self.slots[slot]["chain"], prompt, plen)
-        self.hit_tokens += m * BL
-        return m * BL
+        self.hit_tokens += m_blocks * BL
+        return m_blocks * BL
 
     def ensure_capacity(self, slot, pos):
         st = self.slots[slot]
@@ -237,6 +316,54 @@ class RefPool:
                     self.free += 1
                     e.dtype = self.default_dtype
 
+    def swap_out(self, slot):
+        st = self.slots[slot]
+        n_priv = sum(1 for e in st["chain"] if e.refs == 1)
+        if not self._host_make_room(n_priv):
+            return None
+        st = self.slots.pop(slot)
+        self.reserved -= st["left"]
+        entries = []
+        for e in st["chain"]:
+            if e.refs > 1:
+                # shared: this slot's reference stays pinned in HBM
+                entries.append(("hbm", e))
+                continue
+            if e.path is not None:
+                self._unregister_cascade(e)
+            entries.append(("host", e.dtype))
+            self.host_pinned += 1
+            self.swapped_out += 1
+            e.refs = 0
+            self.free += 1
+            e.dtype = self.default_dtype
+        self.swap_record = {"entries": entries, "left": st["left"]}
+        return self.swap_record
+
+    def swap_in(self, slot):
+        rec = self.swap_record
+        entries = rec["entries"]
+        n_host = sum(1 for e in entries if e[0] == "host")
+        if self.available() < n_host + rec["left"]:
+            return None
+        chain = []
+        for e in entries:
+            if e[0] == "hbm":
+                chain.append(e[1])
+                continue
+            ne = self._pop_block()
+            ne.refs = 1
+            ne.dtype = e[1]
+            chain.append(ne)
+            self.swapped_in += 1
+        # pinned buffers free AFTER the pops — an eviction-demotion
+        # inside _pop_block sees the host tier still holding them
+        self.host_pinned -= n_host
+        self.slots[slot] = {"chain": chain, "left": rec["left"]}
+        self.reserved += rec["left"]
+        self.swap_record = None
+        return len(chain)
+
 
 # ---------------------------------------------------------------------------
 # the op alphabet: (name, enabled(model), apply(mgr, model))
@@ -290,6 +417,43 @@ def _op_release(mgr, model):
     return (mgr.release(s), model.release(s))
 
 
+def _rec_shape(entries_real=None, entries_model=None, left=None):
+    """Comparable shape of a swap record: per-entry tier tag (+ dtype
+    for host entries) and the remembered reservation."""
+    if entries_real is not None:
+        tags = tuple((e[0], e[2]) if e[0] == "host" else ("hbm",)
+                     for e in entries_real)
+    else:
+        tags = tuple((e[0], e[1]) if e[0] == "host" else ("hbm",)
+                     for e in entries_model)
+    return (tags, int(left))
+
+
+def _op_swap_out(mgr, model):
+    rec = mgr.swap_out(0)
+    mrec = model.swap_out(0)
+    if rec is not None:
+        model.real_record = rec
+    real = (None if rec is None else
+            _rec_shape(entries_real=rec["entries"],
+                       left=rec["reserved_left"]))
+    ref = (None if mrec is None else
+           _rec_shape(entries_model=mrec["entries"], left=mrec["left"]))
+    return real, ref
+
+
+def _op_swap_in(mgr, model):
+    real = mgr.resume_swapped(0, model.real_record)
+    ref = model.swap_in(0)
+    if real is not None:
+        model.real_record = None
+    return real, ref
+
+
+def _op_preempt_free(mgr, model):
+    return (mgr.preempt_free(0), model.release(0))
+
+
 def _cow_enabled(m):
     if 1 not in m.slots or not m.slots[1]["chain"]:
         return False
@@ -313,6 +477,16 @@ OPS = [
      lambda m: 2 in m.slots and len(m.slots[2]["chain"]) >= 1, _op_demote),
     ("evict", lambda m: 3 not in m.slots and len(m.lru) > 0, _op_evict),
     ("release", lambda m: len(m.slots) > 0, _op_release),
+    # preemption / tiering ops (ISSUE 16) — gated on the host tier so
+    # the host_blocks=0 sweep explores exactly the pre-tiering space
+    ("swap_out",
+     lambda m: m.host_cap > 0 and 0 in m.slots and m.swap_record is None,
+     _op_swap_out),
+    ("swap_in",
+     lambda m: m.swap_record is not None and 0 not in m.slots,
+     _op_swap_in),
+    ("preempt_free",
+     lambda m: m.host_cap > 0 and 0 in m.slots, _op_preempt_free),
 ]
 _OP_BY_NAME = {name: (name, en, ap) for name, en, ap in OPS}
 
@@ -331,11 +505,16 @@ def _check(mgr, model, trace):
     assert free | ref | lru == usable, ctx
     assert not (free & ref) and not (free & lru) and not (ref & lru), ctx
     assert NULL_BLOCK not in free | ref | lru, ctx
-    # I2: refcounts match the live chains
+    # I2: refcounts match the live chains (+ the references an
+    # outstanding swap record keeps pinned on shared blocks)
     counts = np.zeros(NUM_BLOCKS, np.int64)
     for s in mgr._slots.values():
         for bid in s.chain:
             counts[bid] += 1
+    if model.real_record is not None:
+        for e in model.real_record["entries"]:
+            if e[0] == "hbm":
+                counts[int(e[1])] += 1
     assert (counts == mgr._ref).all(), ctx
     # I3: trie bijection + children consistency + registered not free
     assert mgr._trie == {k: b for b, k in mgr._block_key.items()}, ctx
@@ -364,6 +543,27 @@ def _check(mgr, model, trace):
         row = mgr.table_row(slot, 8)
         assert list(row[:len(st.chain)]) == st.chain, ctx
         assert (row[len(st.chain):] == NULL_BLOCK).all(), ctx
+    # I7: tier invariants — one tier per path, host occupancy ledger,
+    # LRU order agreement, swapped chains invisible to the trie
+    assert set(mgr._block_path) == set(mgr._block_key), ctx
+    if mgr._host is not None:
+        host_paths = set(mgr._host_trie)
+        assert not (host_paths & set(mgr._block_path.values())), ctx
+        hids = [h for h, _ in mgr._host_trie.values()]
+        assert len(hids) == len(set(hids)), ctx
+        assert mgr._host.used == (len(mgr._host_trie)
+                                  + model.host_pinned), ctx
+        assert list(mgr._host_trie) == list(model.host_trie), ctx
+        for p, (_, dt) in mgr._host_trie.items():
+            assert dt == model.host_trie[p], ctx
+        if model.real_record is not None:
+            rec_h = [e[1] for e in model.real_record["entries"]
+                     if e[0] == "host"]
+            assert not (set(rec_h) & set(hids)), ctx
+            assert all(h in mgr._host._live for h in rec_h), ctx
+    assert mgr.host_blocks_used() == (len(model.host_trie)
+                                      + model.host_pinned), ctx
+    assert mgr.host_trie_blocks() == len(model.host_trie), ctx
     # model agreement: every aggregate the engine observes
     assert mgr.free_blocks() == model.free, ctx
     assert mgr.cached_blocks() == len(model.lru), ctx
@@ -387,16 +587,21 @@ def _check(mgr, model, trace):
     assert mgr.stats["evictions"] == model.evictions, ctx
     assert mgr.stats["cow_copies"] == model.cow_copies, ctx
     assert mgr.stats["prefix_hit_tokens"] == model.hit_tokens, ctx
+    assert mgr.stats["host_demotions"] == model.host_demotions, ctx
+    assert mgr.stats["host_promotions"] == model.host_promotions, ctx
+    assert mgr.stats["swapped_out_blocks"] == model.swapped_out, ctx
+    assert mgr.stats["swapped_in_blocks"] == model.swapped_in, ctx
 
 
-def _replay(ops, kv_dtype, check_every=True):
+def _replay(ops, kv_dtype, check_every=True, host_blocks=0):
     """Replay an op sequence on a fresh manager+model pair.  Op RESULTS
     are compared at every step; the full invariant battery runs either
     at every step (directed tests) or only after the final op — in the
     exhaustive sweep every proper prefix is itself a visited node, so
     last-step checking still covers every state exactly once."""
-    mgr = BlockManager(NUM_BLOCKS, BL, kv_dtype=kv_dtype)
-    model = RefPool(kv_dtype)
+    mgr = BlockManager(NUM_BLOCKS, BL, kv_dtype=kv_dtype,
+                       host_blocks=host_blocks)
+    model = RefPool(kv_dtype, host_blocks)
     trace = []
     for i, (name, _, apply) in enumerate(ops):
         trace.append(name)
@@ -409,10 +614,15 @@ def _replay(ops, kv_dtype, check_every=True):
     return mgr, model
 
 
+@pytest.mark.parametrize("host_blocks", [0, HOST_BLOCKS],
+                         ids=["flat", "tiered"])
 @pytest.mark.parametrize("kv_dtype", ["bf16", "mixed", "int8"])
-def test_exhaustive_interleavings(kv_dtype, monkeypatch):
+def test_exhaustive_interleavings(kv_dtype, host_blocks, monkeypatch):
     """All enabled-op interleavings to depth 6, invariants after every
-    step, against the reference model."""
+    step, against the reference model.  ``flat`` is the pre-tiering
+    space (swap ops disabled, eviction drops content); ``tiered`` adds
+    the host tier — eviction demotes, admission promotes, and
+    swap_out/swap_in/preempt_free interleave with everything else."""
     # every BlockManager registers ~10 labelled series; thousands of
     # short-lived pools would bloat the process-wide registry, so give
     # them throwaway registries for the sweep
@@ -424,7 +634,8 @@ def test_exhaustive_interleavings(kv_dtype, monkeypatch):
     def dfs(prefix):
         # replay the prefix on fresh instances (no undo needed: the
         # scope is tiny and replay keeps the checker trivially sound)
-        _, model = _replay(prefix, kv_dtype, check_every=False)
+        _, model = _replay(prefix, kv_dtype, check_every=False,
+                           host_blocks=host_blocks)
         explored[0] += 1
         if len(prefix) == DEPTH:
             return
@@ -452,10 +663,11 @@ def test_model_checker_exercises_every_op(monkeypatch):
             if op[1](model):
                 hit.add(op[0])
                 _, child = _replay(prefix + [op], "mixed",
-                                   check_every=False)
+                                   check_every=False,
+                                   host_blocks=HOST_BLOCKS)
                 dfs(prefix + [op], child)
 
-    dfs([], RefPool("mixed"))
+    dfs([], RefPool("mixed", HOST_BLOCKS))
     assert hit == {name for name, _, _ in OPS}
 
 
@@ -518,3 +730,87 @@ def test_table_row_rejects_null_block_in_live_chain(monkeypatch):
     mgr._slots[0].chain[0] = NULL_BLOCK   # simulate the corruption
     with pytest.raises(AssertionError, match="null block"):
         mgr.table_row(0, 8)
+
+
+def test_swap_out_shared_stays_resident_private_never_hits(monkeypatch):
+    """Directed ISSUE 16 scenario: a swapped-out chain keeps its
+    reference on SHARED blocks (they survive the co-owner's release)
+    while PRIVATE blocks leave HBM entirely — and none of them can
+    serve a prefix hit until the victim resumes and re-registers."""
+    monkeypatch.setattr(_metrics, "default_registry",
+                        lambda: _metrics.MetricsRegistry())
+    mgr = BlockManager(NUM_BLOCKS, BL, host_blocks=HOST_BLOCKS)
+    assert mgr.admit(0, [1, 2, 3], 3, 2) == 0    # registers (1, 2)
+    assert mgr.admit(1, [1, 2, 9], 3, 1) == 2    # adopts the (1, 2) block
+    shared = mgr.chain(0)[0]
+    rec = mgr.swap_out(0)
+    assert rec is not None
+    tags = [e[0] for e in rec["entries"]]
+    assert tags == ["hbm", "host"] and rec["entries"][0][1] == shared
+    # the shared block was unregistered?  No: slot 1 still references
+    # it, but swap-out cascade-unregisters only PRIVATE registered
+    # blocks — the shared (1, 2) block stays a valid trie entry
+    assert mgr.prefix_probe([1, 2, 9]) == BL
+    # the private block's content is host-pinned, NOT a host-trie
+    # entry: nothing about the swapped suffix is admissible
+    assert mgr.host_blocks_used() == 1 and mgr.host_trie_blocks() == 0
+    # co-owner releases; the record's pinned reference keeps the shared
+    # block referenced (not LRU-parked, not evictable)
+    mgr.release(1)
+    assert int(mgr._ref[shared]) == 1
+    assert mgr.cached_blocks() == 0
+    # resume restores the chain; the pool ledger balances
+    assert mgr.resume_swapped(0, rec) == 2
+    assert mgr.chain(0)[0] == shared
+    assert mgr.host_blocks_used() == 0
+    mgr.release(0)
+    assert mgr.blocks_in_use() == 0
+
+
+def test_promotion_survives_eviction_during_admit(monkeypatch):
+    """Directed regression: admitting a prompt that hits a host-trie
+    entry while the free list is EMPTY makes the promotion's own
+    _append_block evict — and the eviction's demotion path calls
+    _host_make_room, which (before the claim-first fix) could evict the
+    very entry pending promotion: its payload was freed before
+    on_swap_in read it and the later trie delete raised KeyError.
+    Promo entries must be claimed out of the host trie before any
+    device allocation."""
+    monkeypatch.setattr(_metrics, "default_registry",
+                        lambda: _metrics.MetricsRegistry())
+    mgr = BlockManager(9, BL, host_blocks=1)
+    tier = mgr.host_tier
+    mgr.on_swap_out = lambda pairs: [tier.put(h, ("payload", b))
+                                     for b, h in pairs]
+    promoted = []
+    # reading the payload INSIDE the hook is the liveness assertion:
+    # a freed host id would raise here
+    mgr.on_swap_in = lambda pairs: [promoted.append((h, b, tier.get(h)))
+                                    for h, b in pairs]
+    # park a two-level registered chain on the LRU
+    assert mgr.admit(0, [1, 2, 3, 4, 5], 5, 1) == 0
+    src_bid = mgr.chain(0)[0]                 # the (1, 2) block
+    mgr.release(0)
+    # pool-filling admission evicts the parked parent -> it demotes to
+    # the host tier (filling its single slot); the cascade frees the
+    # parked child without demoting it
+    assert mgr.admit(1, [9] * 15, 15, 1) == 0
+    assert mgr.host_trie_blocks() == 1
+    hid = mgr._host_trie[((1, 2),)][0]
+    # drain the free list completely: release re-parks slot 1's seven
+    # registered blocks on the LRU, slot 2 takes the lone anonymous one
+    mgr.release(1)
+    assert mgr.admit(2, [50], 1, 1) == 0
+    assert mgr.free_blocks() == 0 and mgr.cached_blocks() == 7
+    # the demoted path hits: promotion must allocate via eviction while
+    # its own host entry stays claimed (alive but not evictable)
+    assert mgr.admit(3, [1, 2, 9, 10], 4, 1) == BL
+    assert promoted == [(hid, mgr.chain(3)[0], ("payload", src_bid))]
+    # the promoted payload's host id was freed AFTER the copy-back, and
+    # nothing re-demoted into the tier mid-promotion
+    assert mgr.host_blocks_used() == 0 and mgr.host_trie_blocks() == 0
+    assert mgr.stats["host_demotions"] == 1
+    assert mgr.stats["host_promotions"] == 1
+    assert mgr.stats["evictions"] == 2
+    # the promoted block serves device prefix hits again
+    assert mgr.prefix_probe([1, 2, 99], 3) == BL

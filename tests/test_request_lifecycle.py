@@ -166,9 +166,9 @@ def test_slo_violation_attribution_by_cause():
     _timeline(log, ttft=2.0, tpot=1.0, tokens=7, slo=slo)  # attained
     rep = log.slo_report()
     assert rep["requests"] == 6                # rejected included
-    assert rep["violations"] == {"rejected": 1, "queue_wait": 1,
-                                 "prefill": 1, "decode": 1,
-                                 "incomplete": 1}
+    assert rep["violations"] == {"rejected": 1, "cancelled": 0,
+                                 "queue_wait": 1, "prefill": 1,
+                                 "decode": 1, "incomplete": 1}
     assert rep["attained"] == 1 and rep["goodput"] == round(1 / 6, 4)
     assert rep["attained_tokens"] == 7
 

