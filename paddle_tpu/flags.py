@@ -380,6 +380,49 @@ DEFINE("perf_model_tol", 3.0,
        "absorbs CPU-smoke scheduling noise — clean tier-1 replays sit "
        "within ~1.5x of calibration but CI machines spike — while a "
        "sustained slowdown past 4x still trips; TPU runs can tighten it")
+# cost-model-driven control plane (serving/admission.py, router.py,
+# serving/autoscaler.py, serving/fleet_sim.py): predictive SLO
+# admission, priced hold queue, replica autoscaling
+DEFINE("serving_admission", "queue_depth",
+       "admission/placement policy for ServingEngine and ReplicaRouter: "
+       "'queue_depth' (the historical reactive policy — admit whenever "
+       "a slot and KV blocks are free, place on the least-loaded "
+       "replica) or 'predictive' (consult CostModel.predicted_tick_ms "
+       "at the hypothetical post-admission state and defer into a "
+       "priced hold queue when the pooled TPOT/TTFT SLO would blow).  "
+       "'predictive' silently degrades to 'queue_depth' when "
+       "FLAGS_perf_model is off or the cost model carries drift "
+       "findings (an uncalibrated model must not gate admission)")
+DEFINE("serving_admission_slack", 1.25,
+       "predictive-admission headroom multiplier: a request is deferred "
+       "when predicted TPOT exceeds tpot_slo_ms * slack (or predicted "
+       "queue-drain time exceeds ttft_slo_ms * slack).  >1 keeps "
+       "admission conservative against model optimism; 1.0 admits "
+       "right up to the SLO line")
+DEFINE("serving_admission_calib", 1.0,
+       "wall-ms per predicted-ms calibration multiplier applied to "
+       "cost-model predictions before they are compared against "
+       "wall-clock SLO deadlines.  The TPU profiles are seeded from "
+       "measured BENCH rows (ratio ~1), so 1.0 is right there; the "
+       "cpu_smoke profile's absolute milliseconds are NOT wall-"
+       "calibrated (BASELINE.md), so CPU benches measure a warm pass "
+       "and set this to measured_tick_ms/predicted_tick_ms — a fixed, "
+       "deterministic input, unlike the live EWMA ratio which would "
+       "make admission decisions replay-dependent.  The fleet "
+       "simulator keeps 1.0: its clock IS the predicted domain")
+DEFINE("serving_admission_max_defer_ticks", 64,
+       "starvation bound for the predictive hold queue: a request "
+       "deferred for this many consecutive scheduler ticks is force-"
+       "admitted/placed regardless of the SLO prediction (aging beats "
+       "pricing).  0 disables forcing")
+DEFINE("serving_autoscale_min_ticks", 8,
+       "ReplicaAutoscaler hysteresis: predicted-SLO pressure (or "
+       "slack) must persist for this many consecutive observe() ticks "
+       "before a scale-up (or drain) decision fires")
+DEFINE("serving_autoscale_cooldown", 16,
+       "ReplicaAutoscaler cooldown: minimum observe() ticks between "
+       "two scaling actions (in either direction) — damps oscillation "
+       "around the goodput target")
 DEFINE("metrics_port", 0,
        "HTTP exposition port for observability.http_exposition: serve "
        "/metrics (Prometheus text), /healthz (liveness + anomaly "

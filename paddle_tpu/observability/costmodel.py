@@ -240,6 +240,17 @@ class CostModel:
         self._memo[key] = out
         return out
 
+    def predicted_tick_ms(self, occ: int, live_tokens: int,
+                          chunk_tokens: int = 0, window: int = 1,
+                          swap_bytes: int = 0) -> float:
+        """Scalar convenience over :meth:`predict` — the control plane
+        (predictive admission, autoscaler, fleet simulator) only needs
+        the tick's bounding milliseconds, not the per-term breakdown."""
+        return float(self.predict(occ, live_tokens,
+                                  chunk_tokens=chunk_tokens,
+                                  window=window,
+                                  swap_bytes=swap_bytes)["predicted_ms"])
+
     def memo_size(self) -> int:
         return len(self._memo)
 
@@ -375,6 +386,14 @@ class TickAttribution:
             self._anom.labels(engine=self._eid, kind="tpot").inc()
 
     # -- report --------------------------------------------------------
+
+    def has_drift(self) -> bool:
+        """Cheap per-tick probe for the control plane: True once any
+        bound's ratio EWMA has left its calibrated band.  Predictive
+        admission consults this before trusting a prediction — the
+        full Finding rendering stays in :meth:`drift_findings`."""
+        with self._lock:
+            return bool(self._drift)
 
     def drift_findings(self) -> List[Any]:
         """Sticky drift findings in the static_analysis Finding shape:

@@ -307,6 +307,46 @@ def check_history(root: Optional[str] = None,
             f"decision_signature_stable="
             f"{ps.get('preempt_signature_stable')}"))
 
+    # control_plane (ISSUE 17): predictive admission must hold its
+    # committed win — goodput at-or-above the reactive baseline with a
+    # strict win on >= 1 SLO class, token-identity where both arms
+    # admitted, a deterministic autoscaler action trace, and the fleet
+    # simulator's 100k x 16 scale row inside the <60 s host-wall budget
+    cp = cpu.get("control_plane", {})
+    if cp:
+        ok = (bool(cp.get("predictive_goodput_ge"))
+              and bool(cp.get("strictly_better_classes"))
+              and bool(cp.get("outputs_token_identical_where_both_admit"))
+              and bool(cp.get("deterministic_replay")))
+        checks.append(_check(
+            "control_plane_row", ok,
+            f"goodput_ge={cp.get('predictive_goodput_ge')} "
+            f"class_wins={cp.get('strictly_better_classes')} "
+            f"token_identical="
+            f"{cp.get('outputs_token_identical_where_both_admit')} "
+            f"deterministic={cp.get('deterministic_replay')}"))
+        asc = cp.get("autoscale", {})
+        if asc:
+            ok = (bool(asc.get("deterministic"))
+                  and bool(asc.get("scaled_up_under_pressure"))
+                  and bool(asc.get("drained_then_retired_on_slack")))
+            checks.append(_check(
+                "autoscale_row", ok,
+                f"deterministic={asc.get('deterministic')} "
+                f"scaled_up={asc.get('scaled_up_under_pressure')} "
+                f"drain_retire="
+                f"{asc.get('drained_then_retired_on_slack')}"))
+        fl = cp.get("fleet_sim", {})
+        if fl:
+            ok = (bool(fl.get("under_60s_host_wall"))
+                  and int(fl.get("requests", 0)) >= 100_000
+                  and int(fl.get("replicas", 0)) >= 16)
+            checks.append(_check(
+                "fleet_sim_row", ok,
+                f"{fl.get('requests')} req x {fl.get('replicas')} "
+                f"replicas in {fl.get('host_wall_s')} s host "
+                f"(sim {fl.get('sim_wall_s')} s)"))
+
     ok = all(c["ok"] is not False for c in checks)
     return {"ok": ok, "root": root, "tolerances": tol, "checks": checks}
 

@@ -11,12 +11,17 @@ decode function compiled exactly once, and a host-side scheduler that
 admits queued requests into freed slots mid-flight.
 """
 
+from .admission import HoldQueue, Verdict, place_verdict
+from .autoscaler import ReplicaAutoscaler
 from .drafter import NgramDrafter
 from .engine import Request, SamplingParams, ServingEngine
+from .fleet_sim import FleetSim, SimEngine, SimSpec, run_fleet
 from .kv_cache import BlockManager, init_paged_kv_cache
 from .loadgen import LoadRequest, LoadSpec, generate_load, replay
 from .router import ReplicaRouter
 
 __all__ = ["ServingEngine", "SamplingParams", "Request", "BlockManager",
            "init_paged_kv_cache", "NgramDrafter", "ReplicaRouter",
-           "LoadRequest", "LoadSpec", "generate_load", "replay"]
+           "LoadRequest", "LoadSpec", "generate_load", "replay",
+           "HoldQueue", "Verdict", "place_verdict", "ReplicaAutoscaler",
+           "FleetSim", "SimEngine", "SimSpec", "run_fleet"]

@@ -1,0 +1,157 @@
+"""Predictive SLO admission primitives — the control plane's pricing
+layer (ISSUE 17 tentpole a).
+
+The reactive serving stack admits on queue depth alone and repairs
+mistakes after the fact (preemption, PR 16).  This module turns the
+calibrated roofline cost model (PR 15) into a *pre-placement* question:
+"will admitting this prompt at this replica's current (occupancy,
+depth, chunk backlog) blow the pooled TPOT/TTFT SLO?"  Two pieces:
+
+* :func:`place_verdict` prices one candidate placement against the
+  engine's :meth:`~paddle_tpu.serving.engine.ServingEngine.
+  admission_probe` — verdict ``admit`` when the predicted post-
+  admission tick (calibrated into wall ms through
+  FLAGS_serving_admission_calib) fits every armed deadline with
+  FLAGS_serving_admission_slack headroom, ``defer`` with a *price*
+  (the worst predicted overage in ms) otherwise;
+
+* :class:`HoldQueue` is the priced deferral queue the router parks
+  deferred requests in instead of blindly rejecting them: entries pop
+  by (aged-first, priority class, price, arrival) — the PR-16 priority
+  classes outrank pricing, the cheapest-to-admit request within a
+  class goes first, and any entry older than
+  FLAGS_serving_admission_max_defer_ticks jumps the whole line
+  (aging beats pricing: the queue can never starve).
+
+Decisions are pure functions of scheduler state — no wall-clock input —
+so twin replays of one deterministic trace hold and place identically
+(the fleet simulator and the loadgen smoke gate both lean on this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional
+
+from .. import flags as _flags
+
+__all__ = ["Verdict", "place_verdict", "HoldEntry", "HoldQueue"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One priced placement decision.  ``verdict`` is ``admit`` or
+    ``defer``; ``price`` is the worst predicted SLO overage in wall ms
+    (0 for admit) — the hold queue orders by it within a priority
+    class.  ``reason`` names the deciding rule."""
+
+    verdict: str
+    predicted_tpot_ms: float = 0.0
+    predicted_ttft_ms: float = 0.0
+    price: float = 0.0
+    reason: str = ""
+
+
+def place_verdict(engine, prompt_len: int, *,
+                  ttft_slo_ms: float = 0.0,
+                  tpot_slo_ms: float = 0.0) -> Verdict:
+    """Price placing one more ``prompt_len``-token request on
+    ``engine``.  Admits unconditionally when the engine has no cost
+    model (FLAGS_perf_model off — today's reactive policy IS the
+    fallback) or the request carries no armed deadline (nothing to
+    protect, and batch traffic must not be starved by a gate it never
+    asked for — the *pooled* guard lives in the engine's own
+    ``_admission_defer``)."""
+    probe = engine.admission_probe(int(prompt_len))
+    if probe is None:
+        return Verdict("admit", reason="no_model")
+    calib = float(_flags.flag("serving_admission_calib"))
+    tpot = probe["predicted_tpot_ms"] * calib
+    ttft = probe["predicted_ttft_ms"] * calib
+    if ttft_slo_ms <= 0 and tpot_slo_ms <= 0:
+        return Verdict("admit", tpot, ttft, reason="no_deadline")
+    slack = float(_flags.flag("serving_admission_slack"))
+    price = 0.0
+    if tpot_slo_ms > 0:
+        price = max(price, tpot - tpot_slo_ms * slack)
+    if ttft_slo_ms > 0:
+        price = max(price, ttft - ttft_slo_ms * slack)
+    if price > 0:
+        return Verdict("defer", tpot, ttft, price, "predicted_slo")
+    return Verdict("admit", tpot, ttft, reason="fits")
+
+
+@dataclasses.dataclass(eq=False)
+class HoldEntry:
+    """One deferred request parked in the hold queue.  ``payload`` is
+    the owner's placement closure state (the router keeps the prompt /
+    sampling / session there); ``seq`` is the arrival tiebreak.
+    Identity equality (``eq=False``): the queue removes entries by
+    object identity and payloads may hold numpy arrays."""
+
+    payload: Any
+    priority: int = 0
+    price: float = 0.0
+    seq: int = 0
+    defer_ticks: int = 0
+
+
+class HoldQueue:
+    """The priced deferral queue.  Pop order: aged entries first (in
+    arrival order — FIFO among the starving), then by descending
+    priority class, ascending price, arrival.  ``tick()`` ages every
+    entry once per scheduler tick; the owner re-prices entries it
+    fails to place (predicted state moved under them)."""
+
+    def __init__(self, max_defer_ticks: Optional[int] = None) -> None:
+        self._max_defer = max_defer_ticks
+        self._entries: List[HoldEntry] = []
+        self._seq = 0
+
+    @property
+    def max_defer_ticks(self) -> int:
+        if self._max_defer is not None:
+            return int(self._max_defer)
+        return int(_flags.flag("serving_admission_max_defer_ticks"))
+
+    def push(self, payload: Any, *, priority: int = 0,
+             price: float = 0.0) -> HoldEntry:
+        e = HoldEntry(payload, priority=int(priority), price=float(price),
+                      seq=self._seq)
+        self._seq += 1
+        self._entries.append(e)
+        return e
+
+    def aged(self, e: HoldEntry) -> bool:
+        """True once ``e`` has waited past the starvation bound — the
+        owner must force-place it regardless of the SLO prediction."""
+        maxd = self.max_defer_ticks
+        return maxd > 0 and e.defer_ticks >= maxd
+
+    def _key(self, e: HoldEntry):
+        return (0 if self.aged(e) else 1,
+                e.seq if self.aged(e) else 0,
+                -e.priority, e.price, e.seq)
+
+    def ordered(self) -> List[HoldEntry]:
+        """Entries in pop order (non-destructive — the owner walks this
+        each tick and removes what it managed to place)."""
+        return sorted(self._entries, key=self._key)
+
+    def remove(self, entry: HoldEntry) -> None:
+        self._entries.remove(entry)
+
+    def tick(self) -> None:
+        for e in self._entries:
+            e.defer_ticks += 1
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __iter__(self) -> Iterator[HoldEntry]:
+        return iter(self._entries)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return [{"priority": e.priority, "price": round(e.price, 6),
+                 "seq": e.seq, "defer_ticks": e.defer_ticks,
+                 "aged": self.aged(e)} for e in self.ordered()]

@@ -224,6 +224,7 @@ class Request:
     ttft_slo_ms: float = 0.0           # deadlines recorded at submit;
     tpot_slo_ms: float = 0.0           # 0 = that deadline disabled
     blocked_ticks: int = 0             # pool-full admission deferrals
+    defer_ticks: int = 0               # predictive-admission deferrals
     priority: int = 0                  # preemption class (higher wins)
     preempt_count: int = 0             # times this request was preempted
     # recompute-resume marker: set ONLY on the synthetic re-prefill
@@ -641,6 +642,11 @@ class ServingEngine:
         self._next_rid = 0
         self._base_key = jax.random.key(seed)
         self._ticks = 0
+        # the scheduler's time source: every SLO stamp (t_submit,
+        # queue-wait, TTFT, TPOT) reads through this indirection, so the
+        # fleet simulator (serving/fleet_sim.py) can drive the SAME
+        # scheduler with a cost-model clock instead of the wall
+        self._clock = time.perf_counter
         self._kernel_preflight_cache = None  # memoized kernel_preflight()
         # trace accounting rides the retrace watchdog
         # (observability/watchdog.py): the wrapper counts compilations —
@@ -775,6 +781,92 @@ class ServingEngine:
         if self._perf is None:
             return {"enabled": False}
         return dict(self._perf.report(), enabled=True)
+
+    # -- predictive SLO admission (control plane) --------------------------
+
+    def admission_armed(self) -> bool:
+        """True when the predictive gate actively prices admissions on
+        this engine: FLAGS_serving_admission is 'predictive', the cost
+        model is built (FLAGS_perf_model on), and the model carries no
+        drift finding — a model that has left its calibrated band must
+        not gate admission (ISSUE 17: fall back conservative)."""
+        return (self._perf is not None
+                and str(_flags.flag("serving_admission")) == "predictive"
+                and not self._perf.has_drift())
+
+    def admission_probe(self, prompt_len: int) -> Optional[Dict[str, float]]:
+        """Price admitting ONE more request at this engine's current
+        (occupancy, queue depth, chunk backlog) — the control-plane
+        placement question the router asks before placing.  Returns the
+        predicted post-admission tick time (which is the per-slot TPOT:
+        decode emits one token per tick) and a coarse TTFT estimate
+        (ticks to drain the backlog ahead, one admission wave per tick,
+        times the predicted tick), or None when FLAGS_perf_model is off.
+        Predictions are in the cost model's domain — compare against
+        wall deadlines through FLAGS_serving_admission_calib."""
+        if self._perf is None:
+            return None
+        occ_now = self.num_active
+        backlog = self.queue_depth + self.num_pending + self.num_preempted
+        occ_after = min(self.num_slots, occ_now + backlog + 1)
+        live = int(self._positions[self._active].sum()) if occ_now else 0
+        chunk = (getattr(self, "prefill_chunk", 0)
+                 if self.chunked and (backlog or self._prefill is not None)
+                 else 0)
+        pred = self._perf.model.predicted_tick_ms(
+            occ_after, live + int(prompt_len), chunk_tokens=chunk,
+            window=self.spec_k + 1 if self.spec else 1)
+        waves = 1 + backlog // max(1, self.prefill_batch)
+        return {"predicted_tick_ms": pred,
+                "predicted_tpot_ms": pred,
+                "predicted_ttft_ms": pred * waves,
+                "occupancy_after": float(occ_after),
+                "backlog": float(backlog)}
+
+    def _admission_defer(self, req: Request, occ_after: int,
+                         live_after: int, chunk_tokens: int = 0) -> bool:
+        """The gate itself: True holds ``req`` in the submit queue this
+        tick.  Pure function of scheduler state (occupancy, live depth,
+        SLO fields, defer age) — NO wall-clock input, so twin replays of
+        one trace make byte-identical decisions.  Never defers into an
+        empty engine (progress guarantee), never defers a recompute
+        resume (its admission was already paid before preemption), and
+        ages out after FLAGS_serving_admission_max_defer_ticks."""
+        if req.resume is not None or not self.admission_armed():
+            return False
+        if occ_after <= 1:
+            return False
+        maxd = int(_flags.flag("serving_admission_max_defer_ticks"))
+        if maxd > 0 and req.defer_ticks >= maxd:
+            return False
+        # the pooled guard: the tightest TPOT deadline among running
+        # slots and the candidate itself — admitting a deadline-free
+        # batch request must not blow a resident interactive SLO
+        guards = [s.req.tpot_slo_ms for s in self._slots
+                  if s is not None and s.req is not None
+                  and s.req.tpot_slo_ms > 0]
+        if req.tpot_slo_ms > 0:
+            guards.append(req.tpot_slo_ms)
+        if not guards:
+            return False
+        pred = self._perf.model.predicted_tick_ms(
+            occ_after, live_after, chunk_tokens=chunk_tokens,
+            window=self.spec_k + 1 if self.spec else 1)
+        calib = float(_flags.flag("serving_admission_calib"))
+        slack = float(_flags.flag("serving_admission_slack"))
+        return pred * calib > min(guards) * slack
+
+    def _defer(self, req: Request) -> None:
+        """Account one predictive deferral: the submit queue IS the
+        engine-level hold queue (head-of-line order preserved), the
+        request just does not enter a slot this tick."""
+        req.defer_ticks += 1
+        self._m_deferred.inc()
+        self._tracer.instant("serving.admission_deferred",
+                             rid=req.request_id)
+        if req.defer_ticks == 1:
+            self._rlog.event(req.uid, "admission_deferred",
+                             engine=self._eid, reason="predicted_slo")
 
     # -- mesh execution (ISSUE 9) ------------------------------------------
 
@@ -922,6 +1014,12 @@ class ServingEngine:
             "serving.admission_blocked",
             "admission attempts deferred because the paged pool could "
             "not cover the request yet").labels(**lbl)
+        self._m_deferred = ctr(
+            "serving.admission_deferred",
+            "admission attempts held back by the predictive SLO gate "
+            "(serving_admission='predictive'): the cost model priced "
+            "the post-admission tick over the pooled TPOT deadline")\
+            .labels(**lbl)
         self._m_prefill_computed = ctr(
             "serving.prefill_tokens_computed",
             "prompt tokens actually prefilled (pads excluded; prefix "
@@ -1249,7 +1347,9 @@ class ServingEngine:
                max_new_tokens: int = 32,
                sampling: Optional[SamplingParams] = None,
                request_uid: Optional[int] = None,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               ttft_slo_ms: Optional[float] = None,
+               tpot_slo_ms: Optional[float] = None) -> int:
         """Enqueue a request; returns its id.  Admission happens inside
         ``step()`` as slots free up (FIFO).
 
@@ -1259,20 +1359,31 @@ class ServingEngine:
         uid correlates every later lifecycle event, across replicas on
         failover included.
 
+        ``ttft_slo_ms`` / ``tpot_slo_ms`` override the ambient SLO
+        flags — the router captures a request's deadlines once at
+        ROUTER submit time and threads them through here, so a request
+        placed ticks later from the predictive hold queue still carries
+        the class deadlines it arrived with (not whatever the flags say
+        at placement time).  None reads the flags (direct callers).
+
         ``priority`` is the preemption class (higher wins; default 0).
         With ``preempt`` armed, the queue admits by priority class
         (stable FIFO within a class) and a blocked admission may evict
         a running lower-priority request — see ``_try_preempt`` for
         the victim selection contract."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if ttft_slo_ms is None:
+            ttft_slo_ms = float(_flags.flag("serving_slo_ttft_ms"))
+        if tpot_slo_ms is None:
+            tpot_slo_ms = float(_flags.flag("serving_slo_tpot_ms"))
         if request_uid is None:
             uid = self._rlog.new_uid()
             self._rlog.event(
                 uid, "submitted", engine=self._eid,
                 prompt_len=int(prompt.size),
                 max_new_tokens=int(max_new_tokens),
-                ttft_slo_ms=float(_flags.flag("serving_slo_ttft_ms")),
-                tpot_slo_ms=float(_flags.flag("serving_slo_tpot_ms")))
+                ttft_slo_ms=float(ttft_slo_ms),
+                tpot_slo_ms=float(tpot_slo_ms))
         else:
             uid = int(request_uid)
         try:
@@ -1309,9 +1420,9 @@ class ServingEngine:
         self._queue.append(Request(
             rid, prompt, int(max_new_tokens),
             sampling or SamplingParams(),
-            t_submit=time.perf_counter(), uid=uid,
-            ttft_slo_ms=float(_flags.flag("serving_slo_ttft_ms")),
-            tpot_slo_ms=float(_flags.flag("serving_slo_tpot_ms")),
+            t_submit=self._clock(), uid=uid,
+            ttft_slo_ms=float(ttft_slo_ms),
+            tpot_slo_ms=float(tpot_slo_ms),
             priority=int(priority)))
         self._m_submitted.inc()
         return rid
@@ -1556,16 +1667,22 @@ class ServingEngine:
     def _next_admit(self) -> Tuple[Deque, Request]:
         """Pick the next request to admit and the queue it lives in.
 
-        With preemption off: resume entries (there are none unless
-        preemption ran) then strict submit FIFO.  With preemption armed
-        the choice spans BOTH queues by ``(-priority, request_id)`` —
-        a priority submit is a scheduling request; parking it behind a
-        blocked lower-priority recompute-resume head would undo the
-        victim selector's work one queue position earlier (and vice
-        versa, a resume entry never jumps a higher-priority submit).
+        With preemption off and the predictive gate disarmed: resume
+        entries (there are none unless preemption ran) then strict
+        submit FIFO.  With preemption armed — or the predictive
+        admission gate armed — the choice spans BOTH queues by
+        ``(-priority, request_id)``: a priority submit is a scheduling
+        request; parking it behind a blocked lower-priority
+        recompute-resume head would undo the victim selector's work one
+        queue position earlier (and vice versa, a resume entry never
+        jumps a higher-priority submit).  The predictive control plane
+        needs the same order for a different reason: its gate DEFERS
+        over-SLO batch work at the queue head, and strict FIFO would
+        let that deferred head keep head-of-line-blocking the
+        interactive class whose deadline the deferral protects.
         Scanning the resume queue first makes resume entries win exact
         ties, though ids are unique so ties cannot actually occur."""
-        if self.preempt == "off":
+        if self.preempt == "off" and not self.admission_armed():
             src = self._resume_q if self._resume_q else self._queue
             return src, src[0]
         best: Optional[Tuple[Tuple[int, int], Deque, Request]] = None
@@ -1702,7 +1819,7 @@ class ServingEngine:
             return finished
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
-        t0 = time.perf_counter()
+        t0 = self._clock()
         with self._tracer.span("serving.decode", slots=occ):
             if self.paged:
                 for i, slot in enumerate(self._slots):
@@ -1724,7 +1841,7 @@ class ServingEngine:
                     jnp.asarray(self._active), jnp.asarray(self._temps),
                     jnp.asarray(self._topk), jnp.asarray(self._topp), key)
             nxt = np.asarray(nxt)        # the tick's one host sync
-        now = time.perf_counter()
+        now = self._clock()
         self._m_step_ms.observe((now - t0) * 1e3)
         self._perf_tick((now - t0) * 1e3, occ)
         finished.extend(self._advance_decode(nxt, now))
@@ -1795,7 +1912,7 @@ class ServingEngine:
         window = np.concatenate([self._tokens[:, None], drafts], axis=1)
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
-        t0 = time.perf_counter()
+        t0 = self._clock()
         with self._tracer.span("serving.verify", slots=occ,
                                drafted=int(draft_ok.sum())):
             if self.paged:
@@ -1824,7 +1941,7 @@ class ServingEngine:
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), key)
             out, n_acc = jax.device_get((out, n_acc))  # the one host sync
-        now = time.perf_counter()
+        now = self._clock()
         self._m_step_ms.observe((now - t0) * 1e3)
         self._perf_tick((now - t0) * 1e3, occ)
         finished.extend(self._advance_decode_spec(
@@ -1935,7 +2052,7 @@ class ServingEngine:
                 drafts, draft_ok = self._propose_drafts()
             window = np.concatenate([self._tokens[:, None], drafts],
                                     axis=1)
-        t0 = time.perf_counter()
+        t0 = self._clock()
         chunk_span = (self._tracer.span("serving.chunk", slot=cslot,
                                         start=cpos, tokens=clen)
                       if do_chunk else contextlib.nullcontext())
@@ -2001,7 +2118,7 @@ class ServingEngine:
             else:
                 nxt, ctok, self._cache = res
                 nxt, ctok = jax.device_get((nxt, ctok))  # the one sync
-        now = time.perf_counter()
+        now = self._clock()
         self._m_step_ms.observe((now - t0) * 1e3)
         self._perf_tick((now - t0) * 1e3, occ,
                         chunk_tokens=clen if do_chunk else 0)
@@ -2030,6 +2147,13 @@ class ServingEngine:
         if not free:
             return []
         src, req = self._next_admit()
+        occ = self.num_slots - len(free)
+        live = int(self._positions[self._active].sum()) if occ else 0
+        if self._admission_defer(req, occ + 1,
+                                 live + int(req.prompt.size),
+                                 chunk_tokens=self.prefill_chunk):
+            self._defer(req)
+            return []
         si = free[0]
         m = 0
         if self.paged:
@@ -2059,7 +2183,7 @@ class ServingEngine:
             # chunked admission streams into a reused row: drop the
             # previous tenant's granule scales before the first chunk
             self._cache = self._row_reset_fn(self._cache, jnp.int32(si))
-        now = time.perf_counter()
+        now = self._clock()
         self._m_prefill_total.inc(int(req.prompt.size))
         if req.resume is None:
             req.t_admit = now
@@ -2164,7 +2288,11 @@ class ServingEngine:
 
     @property
     def num_active(self) -> int:
-        return int(self._active.sum())
+        # _slots and _active are kept in lockstep (_clear_slot /
+        # admission); list.count beats a numpy reduction at this size,
+        # and the router's least-loaded probe calls this per replica
+        # per submit — 1.6M times in a 100k-request fleet replay
+        return self.num_slots - self._slots.count(None)
 
     @property
     def queue_depth(self) -> int:
@@ -2723,18 +2851,32 @@ class ServingEngine:
         if self.paged:
             return self._admit_paged()
         finished: List[int] = []
-        while self._queue:
+        deferred = False
+        while self._queue and not deferred:
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
                 break
+            occ = self.num_slots - len(free)
+            live = int(self._positions[self._active].sum()) if occ else 0
             bucket = min(self._bucket(len(self._queue[0].prompt)),
                          self.max_length)
             wave: List[Request] = []
+            wave_tokens = 0
             while (self._queue
                    and len(wave) < min(self.prefill_batch, len(free))
                    and min(self._bucket(len(self._queue[0].prompt)),
                            self.max_length) == bucket):
+                head = self._queue[0]
+                if self._admission_defer(
+                        head, occ + len(wave) + 1,
+                        live + wave_tokens + int(head.prompt.size)):
+                    self._defer(head)
+                    deferred = True
+                    break
                 wave.append(self._queue.popleft())
+                wave_tokens += int(head.prompt.size)
+            if not wave:
+                break
             finished.extend(self._prefill_wave(wave, free[:len(wave)],
                                                bucket))
         return finished
@@ -2755,14 +2897,24 @@ class ServingEngine:
         all."""
         self._service_swap_resumes()
         finished: List[int] = []
-        while self._resume_q or self._queue:
+        deferred = False
+        while (self._resume_q or self._queue) and not deferred:
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
                 break
+            occ = self.num_slots - len(free)
+            live = int(self._positions[self._active].sum()) if occ else 0
             wave: List[Tuple[Request, int, int]] = []
+            wave_tokens = 0
             while ((self._resume_q or self._queue)
                    and len(wave) < min(self.prefill_batch, len(free))):
                 src, req = self._next_admit()
+                if self._admission_defer(
+                        req, occ + len(wave) + 1,
+                        live + wave_tokens + int(req.prompt.size)):
+                    self._defer(req)
+                    deferred = True
+                    break
                 si = free[len(wave)]
                 m = self.kv.admit(si, req.prompt, req.prompt.size,
                                   req.max_new_tokens)
@@ -2786,6 +2938,7 @@ class ServingEngine:
                 src.remove(req)
                 self._tables[si] = self.kv.table_row(si, self.max_blocks)
                 wave.append((req, si, m))
+                wave_tokens += int(req.prompt.size)
             if not wave:
                 break
             finished.extend(self._prefill_wave_paged(wave))
@@ -2793,7 +2946,7 @@ class ServingEngine:
 
     def _prefill_wave_paged(self, wave: List[Tuple[Request, int, int]]
                             ) -> List[int]:
-        t_adm = time.perf_counter()
+        t_adm = self._clock()
         nb = self.prefill_batch
         bucket = min(max(self._bucket(req.prompt.size - m)
                          for req, _, m in wave), self.max_length)
@@ -2843,7 +2996,7 @@ class ServingEngine:
                 jnp.asarray(topk), jnp.asarray(topp), key)
             tok = np.asarray(tok)
         self._apply_demotions()
-        t_tok = time.perf_counter()
+        t_tok = self._clock()
         finished: List[int] = []
         for r, (req, si, m) in enumerate(wave):
             ri = req.resume
@@ -2891,7 +3044,7 @@ class ServingEngine:
 
     def _prefill_wave(self, wave: List[Request], slots: List[int],
                       bucket: int) -> List[int]:
-        t_adm = time.perf_counter()
+        t_adm = self._clock()
         nb = self.prefill_batch
         ids = np.full((nb, bucket), self.pad_token_id, np.int32)
         plens = np.ones((nb,), np.int32)
@@ -2931,7 +3084,7 @@ class ServingEngine:
                 jnp.asarray(temps), jnp.asarray(topk),
                 jnp.asarray(topp), key)
             tok = np.asarray(tok)
-        t_tok = time.perf_counter()
+        t_tok = self._clock()
         finished: List[int] = []
         for r, (req, si) in enumerate(zip(wave, slots)):
             slot = _Slot(req.request_id, req.max_new_tokens - 1,

@@ -1,0 +1,612 @@
+"""Device-free fleet simulator: the REAL control plane on a cost-model
+clock (ISSUE 17 tentpole c).
+
+``SimEngine`` is a :class:`~paddle_tpu.serving.engine.ServingEngine`
+with the device removed and NOTHING else replaced: the same ``submit``
+/ ``step`` / ``drain`` scheduler, the same paged admission
+(``_admit_paged``), the same :class:`~paddle_tpu.serving.kv_cache.
+BlockManager` pool (prefix trie, COW, reservations, host tier), the
+same preemption machinery and the same predictive-admission gate — but
+every jitted dispatch is replaced by the roofline cost model's
+prediction for that tick, and the engine's ``_clock`` indirection (the
+one time source every SLO stamp reads through) returns a simulated
+clock that those predictions advance.  Tokens are synthesized by a
+deterministic hash, so a trace replays byte-identically however fast
+the host runs it.
+
+``FleetSim`` puts N SimEngines behind the REAL
+:class:`~paddle_tpu.serving.router.ReplicaRouter` — predictive
+admission, the priced hold queue and elastic add/drain/retire all
+execute the production code paths — which is what lets a ≥100k-request,
+≥16-replica heavy-tail scenario replay in seconds of CPU wall and
+answer capacity questions (replica counts, admission policies, SLO
+settings) without a device.
+
+What the simulator deliberately does NOT model (BASELINE.md
+"Simulated-clock accounting conventions"): compile/retrace time,
+host-swap wall jitter, and any measured/predicted residual — measured
+IS predicted here, so the perf layer sees ratio 1.0 everywhere and the
+drift detectors stay quiet by construction.  Sim milliseconds are the
+cost model's domain; never compare them against wall milliseconds
+without the FLAGS_serving_admission_calib bridge.
+
+Unsupported engine modes raise at construction: chunked prefill,
+speculative decoding and meshes change the dispatch structure the
+simulator replaces, and quantized caches only change device bytes the
+sim spec already captures in ``kv_token_bytes``.
+
+CLI::
+
+    python -m paddle_tpu.serving.fleet_sim --requests 100000 \
+        --replicas 16 --admission predictive
+
+runs the heavy-tail scale scenario twice and gates the two runs'
+signatures byte-identical (the determinism contract the bench row and
+the loadgen ``fleet_sim`` smoke mode also enforce).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import flags as _flags
+from ..observability import costmodel as _cm
+from ..observability import tracing as _obs
+from . import loadgen as _loadgen
+from .engine import ServingEngine, _Slot
+from .kv_cache import BlockManager
+from .router import ReplicaRouter
+
+__all__ = ["SimSpec", "SimEngine", "FleetSim", "fleet_load_spec",
+           "run_fleet", "fleet_signature", "main"]
+
+#: synthesized-token alphabet (any fixed size works; matching a real
+#: tokenizer's vocab keeps prompt/output token ids in a familiar range)
+_SIM_VOCAB = 50257
+
+
+@dataclasses.dataclass(frozen=True)
+class SimSpec:
+    """The simulated model: exactly the static byte/FLOP inputs the
+    roofline :class:`~paddle_tpu.observability.costmodel.CostModel`
+    needs — nothing else about the model matters to the scheduler."""
+
+    name: str
+    weight_bytes: int           # params footprint streamed per tick
+    n_params: int               # dense FLOP model: 2*N per token
+    kv_token_bytes: float       # HBM bytes one live context token costs
+
+    @classmethod
+    def default(cls) -> "SimSpec":
+        """A ~940M-param bf16 decoder (the committed llama_940m bench
+        shape): 24 layers x 2 (K+V) x 4 kv-heads x 64 head-dim = 12288
+        cache elements per token at 2 bytes each."""
+        return cls(name="sim_940m", weight_bytes=1_880_000_000,
+                   n_params=940_000_000,
+                   kv_token_bytes=float(24 * 2 * 4 * 64 * 2))
+
+    @classmethod
+    def from_engine(cls, engine: ServingEngine) -> "SimSpec":
+        """Clone a live engine's cost-model inputs, so a SimEngine
+        predicts exactly what the real engine's perf layer predicts —
+        the sim-vs-engine agreement gate builds its twin this way."""
+        if engine._perf is None:
+            raise ValueError(
+                "SimSpec.from_engine needs the engine's cost model: "
+                "construct the engine with FLAGS_perf_model='on'")
+        m = engine._perf.model
+        return cls(name=f"from_engine_{engine._eid}",
+                   weight_bytes=m.weight_bytes, n_params=m.n_params,
+                   kv_token_bytes=m.kv_token_bytes)
+
+
+class SimEngine(ServingEngine):
+    """ServingEngine minus the device (see module docstring).
+
+    The constructor deliberately does NOT chain to
+    ``ServingEngine.__init__`` — there is no model, no params, no
+    jitted program — but it builds the identical host-side state
+    catalog, so every inherited scheduler method (``submit``, ``step``,
+    ``_admit_paged``, preemption, cancel, metrics, the predictive
+    admission gate) runs unmodified.  Only four methods are overridden:
+    ``_step_inner`` and ``_prefill_wave_paged`` swap the dispatch for a
+    cost-model prediction + simulated-clock advance, and the two host-
+    tier hooks account swap bytes without moving payloads."""
+
+    def __init__(self, spec: SimSpec, *, num_slots: int = 8,
+                 max_length: int = 1024, prefill_batch: int = 4,
+                 seed: int = 0, block_len: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 preempt: Optional[str] = None,
+                 host_blocks: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: int = 0,
+                 profile: Optional[_cm.HardwareProfile] = None):
+        self.sim_spec = spec
+        self.model = None
+        self.config = None
+        self.num_slots = int(num_slots)
+        self.max_length = int(max_length)
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = int(pad_token_id)
+        self.prefill_batch = int(prefill_batch)
+        self._int8_weights = False
+        # the simulator is paged-only: the BlockManager IS the part of
+        # the memory system worth simulating (admission blocking,
+        # prefix hits, preemption, the host tier)
+        self.paged = True
+        self.kv_dtype = "bf16"
+        self.quantized = False
+        if bool(_flags.flag("serving_chunked_prefill")):
+            raise NotImplementedError(
+                "SimEngine does not model chunked prefill (the mixed "
+                "step's chunk cursor is a dispatch-structure feature)")
+        if bool(_flags.flag("serving_spec_decode")):
+            raise NotImplementedError(
+                "SimEngine does not model speculative decoding (accept "
+                "rates depend on real logits)")
+        self.chunked = False
+        self.prefill_chunk = int(_flags.flag("serving_prefill_chunk"))
+        self._chunk_policy = "prefill"
+        self.spec = False
+        self.spec_k = int(_flags.flag("serving_spec_k"))
+        self.preempt = str(_flags.flag("serving_preempt")
+                           if preempt is None else preempt)
+        if self.preempt not in ("off", "swap", "recompute"):
+            raise ValueError(
+                f"preempt must be off|swap|recompute, got "
+                f"{self.preempt!r}")
+        self._preempt_after = int(_flags.flag("serving_preempt_after"))
+        hb = int(_flags.flag("serving_host_blocks")
+                 if host_blocks is None else host_blocks)
+        if self.preempt == "swap" and hb < 1:
+            raise ValueError(
+                "preempt='swap' needs a host tier: pass host_blocks "
+                "(or FLAGS_serving_host_blocks) >= 1")
+        self._host_blocks = hb
+        self.mesh = None
+        self._init_metrics()
+        bl = int(block_len or _flags.flag("kv_cache_block_len"))
+        if self.max_length % bl:
+            raise ValueError(
+                f"max_length {self.max_length} is not a multiple of "
+                f"block_len {bl}")
+        self.block_len = bl
+        self.max_blocks = self.max_length // bl
+        nb = int(num_blocks or _flags.flag("kv_cache_num_blocks")
+                 or self.num_slots * self.max_blocks + 1)
+        self.kv = BlockManager(
+            nb, bl,
+            prefix_cache=bool(_flags.flag("serving_prefix_cache")
+                              if prefix_cache is None else prefix_cache),
+            kv_dtype=self.kv_dtype,
+            host_blocks=self._host_blocks)
+        self._sim_block_nbytes = int(round(spec.kv_token_bytes * bl))
+        self.kv.set_block_nbytes({"bf16": self._sim_block_nbytes})
+        self._tables = np.zeros((self.num_slots, self.max_blocks),
+                                np.int32)
+        self._params = None
+        self._cache = None               # the pool has no device twin
+        self._pending_demote: List[int] = []
+        # COW privatisation is pool bookkeeping here; the device copy
+        # the real engine dispatches has no simulated cost of its own
+        # (it rides inside the tick the cost model already prices)
+        self._cow_fn = lambda cache, src, dst: cache
+        self._tick_swap_bytes = 0
+        if self._host_blocks > 0:
+            self.kv.on_swap_out = self._host_swap_out
+            self.kv.on_swap_in = self._host_swap_in
+        s = self.num_slots
+        self._tokens = np.zeros((s,), np.int32)
+        self._positions = np.zeros((s,), np.int32)
+        self._active = np.zeros((s,), bool)
+        self._temps = np.zeros((s,), np.float32)
+        self._topk = np.zeros((s,), np.int32)
+        self._topp = np.ones((s,), np.float32)
+        self._slots: List[Optional[_Slot]] = [None] * s
+        self._prefill = None
+        self._queue = deque()
+        self._swap_resume = []
+        self._resume_q = deque()
+        self._preempt_log: List[Dict[str, object]] = []
+        self._results: Dict[int, List[int]] = {}
+        self._next_rid = 0
+        self._base_key = None            # tokens are hash-synthesized
+        self._seed = int(seed)
+        self._ticks = 0
+        # the simulated clock: every SLO stamp reads _clock(), and the
+        # overridden tick bodies advance _now_s by the model's
+        # prediction — sim seconds ARE predicted milliseconds / 1e3
+        self._now_s = 0.0
+        self._clock = lambda: self._now_s
+        self._kernel_preflight_cache = None
+        self._step_fn = None
+        self._prefill_fn = None
+        self._linted = True              # no jitted program to lint
+        self._cost = _cm.CostModel(
+            profile or _cm.resolve_profile(),
+            weight_bytes=spec.weight_bytes, n_params=spec.n_params,
+            kv_token_bytes=spec.kv_token_bytes,
+            num_slots=self.num_slots)
+        self._perf = (_cm.TickAttribution(self._cost,
+                                          engine_id=self._eid)
+                      if _flags.flag("perf_model") == "on" else None)
+
+    # -- simulated time ----------------------------------------------------
+
+    @property
+    def sim_time_s(self) -> float:
+        """This replica's simulated clock (cost-model seconds)."""
+        return self._now_s
+
+    def _sim_token(self, slot: _Slot, i: int) -> int:
+        """Deterministic token synthesis: a pure hash of (request id,
+        position, seed), steered off the EOS id so the trace's
+        max_new_tokens — not sampling luck — decides every length."""
+        pos = int(self._positions[i])
+        tok = (slot.rid * 1_000_003 + pos * 10_007
+               + self._seed * 7_919) % _SIM_VOCAB
+        if self.eos_token_id is not None and tok == self.eos_token_id:
+            tok = (tok + 1) % _SIM_VOCAB
+        return tok
+
+    # -- overridden tick bodies --------------------------------------------
+
+    def _step_inner(self) -> List[int]:
+        """The real ``_step_inner`` with the jitted decode dispatch
+        replaced by a cost-model prediction: identical admission,
+        identical paged bookkeeping (chain growth, COW, tables),
+        identical retirement — the simulated clock advances by the
+        tick's predicted milliseconds and ``_perf_tick`` records
+        measured == predicted (ratio 1.0, no drift, byte-stable
+        perf signature)."""
+        finished = self._admit()
+        occ = int(self._active.sum())
+        self._set_occupancy(occ)
+        if not occ:
+            return finished
+        self._ticks += 1
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._grow_row_for_writes(i, int(self._positions[i]))
+        # inactive rows hold position 0 (_clear_slot), so the full sum
+        # IS the live-token depth — no boolean-mask temporary
+        live = int(self._positions.sum())
+        swap_bytes, self._tick_swap_bytes = self._tick_swap_bytes, 0
+        pred = self._cost.predicted_tick_ms(occ, live,
+                                            swap_bytes=swap_bytes)
+        self._now_s += pred / 1e3
+        now = self._clock()
+        self._m_step_ms.observe(pred)
+        if self._perf is not None:
+            # same memo key as the prediction above: measured ==
+            # predicted exactly, ratio 1.0, detectors quiet
+            self._perf.on_tick(pred, occ=occ, live_tokens=live,
+                               swap_bytes=swap_bytes)
+        nxt = np.full((self.num_slots,), self.pad_token_id, np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                nxt[i] = self._sim_token(slot, i)
+        finished.extend(self._advance_decode(nxt, now))
+        return finished
+
+    def _prefill_wave_paged(self, wave) -> List[int]:
+        """The real paged wave prefill minus the device: identical
+        admission bookkeeping and lifecycle events, first tokens
+        synthesized, and the simulated clock advanced by the wave's
+        modeled cost — priced as one tick whose chunk term carries the
+        computed suffix tokens (prefix hits ride free, exactly like the
+        real wave's suffix-only compute)."""
+        t_adm = self._clock()
+        bucket = min(max(self._bucket(req.prompt.size - m)
+                         for req, _, m in wave), self.max_length)
+        suffix_tokens = 0
+        for req, si, m in wave:
+            suffix = int(req.prompt.size) - int(m)
+            suffix_tokens += suffix
+            self._m_prefill_computed.inc(suffix)
+            self._m_prefill_total.inc(int(req.prompt.size))
+            if req.resume is None:
+                self._m_queue_wait.observe((t_adm - req.t_submit) * 1e3)
+                req.t_admit = t_adm
+                self._rlog.event(req.uid, "admitted", engine=self._eid,
+                                 slot=int(si),
+                                 queue_wait_ms=(t_adm - req.t_submit)
+                                 * 1e3,
+                                 blocked_ticks=int(req.blocked_ticks),
+                                 prefix_hit_tokens=int(m))
+            self._rlog.event(req.uid, "prefill", engine=self._eid,
+                             bucket=int(bucket), tokens=suffix)
+        self._m_waves.inc()
+        self._f_bucket.labels(engine=self._eid, bucket=str(bucket)).inc()
+        self._ticks += 1
+        pred = self._cost.predicted_tick_ms(
+            len(wave), suffix_tokens, chunk_tokens=suffix_tokens)
+        self._now_s += pred / 1e3
+        t_tok = self._clock()
+        finished: List[int] = []
+        for req, si, m in wave:
+            ri = req.resume
+            if ri is not None:
+                first = ri.last_token
+                slot = _Slot(req.request_id, ri.remaining,
+                             t_first=ri.t_first, prompt=ri.orig.prompt,
+                             req=ri.orig)
+            else:
+                slot = _Slot(req.request_id, req.max_new_tokens - 1,
+                             t_first=t_tok, prompt=req.prompt, req=req)
+            self._slots[si] = slot
+            self._active[si] = True
+            self._positions[si] = req.prompt.size
+            self._temps[si] = req.sampling.temperature
+            self._topk[si] = req.sampling.top_k
+            self._topp[si] = req.sampling.top_p
+            if ri is not None:
+                self._tokens[si] = first
+                self._rlog.event(req.uid, "resumed", engine=self._eid,
+                                 mode="recompute", slot=int(si))
+                self._f_resumed.labels(engine=self._eid,
+                                       mode="recompute").inc()
+                self._tracer.instant("serving.resumed",
+                                     rid=req.request_id,
+                                     mode="recompute", slot=int(si))
+                continue
+            first = self._sim_token(slot, si)
+            self._tokens[si] = first
+            self._results[req.request_id].append(first)
+            self._m_tokens.inc()
+            self._m_ttft.observe((t_tok - req.t_submit) * 1e3)
+            if self._perf is not None:
+                self._perf.on_ttft((t_tok - req.t_submit) * 1e3)
+            self._rlog.event(req.uid, "first_token", engine=self._eid,
+                             ttft_ms=(t_tok - req.t_submit) * 1e3)
+            reason = self._finish_reason(first, slot, si)
+            if reason is not None:
+                finished.append(req.request_id)
+                self._retire(slot, si, reason, t_tok)
+        return finished
+
+    # -- host-tier hooks (byte accounting only) ----------------------------
+
+    def _host_swap_out(self, pairs):
+        tier = self.kv.host_tier
+        for bid, hid in pairs:
+            tier.put(hid, None)          # the payload is virtual
+            self._tick_swap_bytes += self._sim_block_nbytes
+            self._m_swap_out_bytes.inc(self._sim_block_nbytes)
+
+    def _host_swap_in(self, pairs):
+        for hid, bid in pairs:
+            self._tick_swap_bytes += self._sim_block_nbytes
+            self._m_swap_in_bytes.inc(self._sim_block_nbytes)
+
+    # -- device-only surfaces ----------------------------------------------
+
+    def lint_step(self):
+        """No jitted program, nothing to lint."""
+        return []
+
+    def kernel_preflight(self):
+        raise NotImplementedError(
+            "SimEngine has no device programs to preflight")
+
+
+class FleetSim:
+    """N SimEngine replicas behind the real ReplicaRouter (same
+    ``submit``/``step``/``drain``/``result`` surface, so
+    ``loadgen.replay`` drives it unchanged).  Per-replica simulated
+    clocks advance independently — replicas tick in lockstep but a
+    loaded replica's tick costs more — and the fleet's simulated wall
+    is the slowest replica's clock."""
+
+    def __init__(self, num_replicas: int = 16,
+                 spec: Optional[SimSpec] = None, *,
+                 policy: Optional[str] = None, seed: int = 0,
+                 **engine_kwargs: Any):
+        self.spec = spec or SimSpec.default()
+        self.engines = [SimEngine(self.spec, seed=seed + i,
+                                  **engine_kwargs)
+                        for i in range(int(num_replicas))]
+        self.router = ReplicaRouter(engines=self.engines, policy=policy)
+
+    # the router surface loadgen.replay expects
+    def submit(self, *a: Any, **kw: Any) -> int:
+        return self.router.submit(*a, **kw)
+
+    def step(self) -> List[int]:
+        return self.router.step()
+
+    def drain(self):
+        return self.router.drain()
+
+    def result(self, rid: int) -> List[int]:
+        return self.router.result(rid)
+
+    @property
+    def pending_held(self) -> int:
+        return self.router.pending_held
+
+    @property
+    def sim_wall_s(self) -> float:
+        """Fleet simulated wall: the slowest replica's clock."""
+        return max(e.sim_time_s for e in self.engines)
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "spec": dataclasses.asdict(self.spec),
+            "replicas": len(self.engines),
+            "sim_wall_s": round(self.sim_wall_s, 6),
+            "per_replica": [
+                {"ticks": e._ticks,
+                 "sim_time_s": round(e.sim_time_s, 6),
+                 "requests_finished": int(e._m_finished.value()),
+                 "tokens_generated": int(e._m_tokens.value())}
+                for e in self.engines],
+            "router": self.router.metrics()["aggregate"]["control_plane"],
+        }
+
+
+def fleet_signature(fleet: FleetSim,
+                    replay_report: Dict[str, Any]) -> str:
+    """sha256 over the deterministic state of one fleet replay: the
+    structural request timeline, every replica's scheduler counters +
+    simulated clock + preemption log + perf signature, and the sampled
+    outputs.  Engine/router ids and host wall-clock fields are
+    excluded, so two identical-seed runs in one process (fresh engines,
+    new ids) must produce byte-identical signatures."""
+    body = {
+        "timeline": replay_report["signature"],
+        "outputs": [o if o is None else list(map(int, o))
+                    for o in replay_report["outputs"]],
+        "per_replica": [
+            {"ticks": e._ticks,
+             "clock_ms": round(e.sim_time_s * 1e3, 6),
+             "preempt": e.preempt_signature(),
+             "perf": (_cm.perf_signature(e._perf.report())
+                      if e._perf is not None else None)}
+            for e in fleet.engines],
+        "decisions": fleet.router.metrics()["aggregate"]["control_plane"][
+            "decisions"],
+    }
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def fleet_load_spec(requests: int, *, seed_gap: float = 0.13,
+                    replicas: int = 16,
+                    num_slots: int = 8) -> _loadgen.LoadSpec:
+    """The heavy-tail scale scenario: Zipf prompt/output lengths (many
+    short requests, a long tail out to 8x the median), Poisson arrivals
+    tuned just under the fleet's token service rate so queues stay
+    loaded but bounded, and a Zipf tenant mix sharing prompt prefixes
+    (the prefix trie sees realistic hit rates at scale)."""
+    # service ~= replicas*num_slots tokens per fleet tick; the mean
+    # Zipf output is ~14 tokens, so gap = 0.13 ticks lands near 85%
+    # decode utilization before prefill waves claim their ticks
+    gap = seed_gap * (16 * 8) / max(1, replicas * num_slots)
+    return _loadgen.LoadSpec(
+        n_requests=int(requests), vocab=256,
+        arrival="poisson", mean_gap=gap,
+        prompt_dist="zipf", prompt_buckets=(8, 16, 32, 64, 224),
+        prompt_zipf_a=1.1, prompt_max=224,
+        output_dist="zipf", output_buckets=(4, 8, 16, 32, 64),
+        output_zipf_a=1.1, output_max=64,
+        tenants=8, tenant_zipf_a=1.2, shared_prefix_len=8)
+
+
+def run_fleet(*, requests: int = 100_000, replicas: int = 16,
+              num_slots: int = 8, max_length: int = 512,
+              admission: str = "predictive", policy: str = "least_loaded",
+              preempt: str = "off", host_blocks: int = 0,
+              seed: int = 0, spec: Optional[SimSpec] = None,
+              profile: str = "v5e",
+              max_ticks: Optional[int] = None) -> Dict[str, Any]:
+    """One deterministic fleet replay of the heavy-tail scenario.
+    Returns the loadgen replay report plus the fleet report and the
+    run's :func:`fleet_signature`.  Flags are scoped to the run and
+    restored on exit."""
+    saved = {k: _flags.flag(k) for k in
+             ("serving_admission", "perf_model", "request_log_max_requests",
+              "serving_chunked_prefill", "serving_spec_decode")}
+    # keep the scale run's memory bounded: the rolling request-log
+    # window covers the trace tail, plenty for the structural signature
+    _flags.set_flags({
+        "serving_admission": admission,
+        "perf_model": "on",
+        "serving_chunked_prefill": False,
+        "serving_spec_decode": False,
+        "request_log_max_requests": min(8192, max(4096, requests // 8))})
+    tracer = _obs.get_tracer()
+    saved_trace = tracer.enabled
+    # span tracing at 100k-request scale is pure host overhead (the
+    # run's artifact is the fleet signature, not a trace); the request
+    # log keeps its structural timeline either way
+    tracer.enabled = False
+    try:
+        fleet = FleetSim(replicas, spec, policy=policy, seed=seed,
+                         num_slots=num_slots, max_length=max_length,
+                         preempt=preempt, host_blocks=host_blocks,
+                         profile=_cm.PROFILES[profile])
+        load = _loadgen.generate_load(
+            fleet_load_spec(requests, replicas=replicas,
+                            num_slots=num_slots), seed=seed)
+        t0 = time.perf_counter()
+        rep = _loadgen.replay(fleet, load, max_ticks=max_ticks)
+        wall = time.perf_counter() - t0
+        out = {
+            "requests": requests,
+            "replicas": replicas,
+            "admission": admission,
+            "ticks": rep["ticks"],
+            "generated_tokens": rep["generated_tokens"],
+            "rejected": rep["rejected"],
+            "host_wall_s": round(wall, 3),
+            "sim_wall_s": round(fleet.sim_wall_s, 3),
+            "sim_tok_per_s": round(
+                rep["generated_tokens"] / max(fleet.sim_wall_s, 1e-9), 3),
+            "goodput": rep["slo"].get("goodput"),
+            "fleet": fleet.report(),
+            "signature": fleet_signature(fleet, rep),
+        }
+        return out
+    finally:
+        tracer.enabled = saved_trace
+        _flags.set_flags(saved)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="device-free serving fleet simulator (cost-model "
+                    "clock; see module docstring)")
+    p.add_argument("--requests", type=int, default=100_000)
+    p.add_argument("--replicas", type=int, default=16)
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--max-length", type=int, default=512)
+    p.add_argument("--admission", default="predictive",
+                   choices=("queue_depth", "predictive"))
+    p.add_argument("--policy", default="least_loaded",
+                   choices=("prefix", "least_loaded", "round_robin"))
+    p.add_argument("--preempt", default="off",
+                   choices=("off", "swap", "recompute"))
+    p.add_argument("--host-blocks", type=int, default=0)
+    p.add_argument("--profile", default="v5e",
+                   choices=sorted(_cm.PROFILES),
+                   help="roofline profile the simulated replicas run "
+                        "on (the sim clock's time domain)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--runs", type=int, default=2,
+                   help="replays to run; >1 gates byte-stable "
+                        "signatures across runs")
+    args = p.parse_args(argv)
+    sigs: List[str] = []
+    for run in range(max(1, args.runs)):
+        rep = run_fleet(requests=args.requests, replicas=args.replicas,
+                        num_slots=args.num_slots,
+                        max_length=args.max_length,
+                        admission=args.admission, policy=args.policy,
+                        preempt=args.preempt, profile=args.profile,
+                        host_blocks=args.host_blocks, seed=args.seed)
+        sigs.append(rep["signature"])
+        slim = {k: v for k, v in rep.items() if k != "fleet"}
+        print(json.dumps({"run": run, **slim}, indent=2, default=str))
+    if len(set(sigs)) != 1:
+        print("FLEET SIM NON-DETERMINISTIC: signatures differ across "
+              "identical-seed runs")
+        return 1
+    print(f"signature stable across {len(sigs)} run(s): {sigs[0][:16]}…")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -176,8 +176,12 @@ def replay(target, load: Sequence[LoadRequest],
     engines = list(getattr(target, "engines", [target]))
 
     def busy() -> bool:
-        return any(e.queue_depth or e.num_active or e.num_pending
-                   or getattr(e, "num_preempted", 0) for e in engines)
+        # pending_held: requests parked in a router's predictive hold
+        # queue (ISSUE 17) — invisible to every engine, so the replay
+        # must poll the target itself or it would stop with work parked
+        return bool(getattr(target, "pending_held", 0)) or any(
+            e.queue_depth or e.num_active or e.num_pending
+            or getattr(e, "num_preempted", 0) for e in engines)
 
     order = sorted(range(len(load)),
                    key=lambda i: (load[i].arrival, load[i].index))
@@ -362,9 +366,69 @@ def _smoke() -> int:
             summary[mode]["preemptions"] = preemptions
             summary[mode]["preempt_signature_stable"] = (
                 len(set(preempt_sigs)) <= 1)
+    summary["fleet_sim"] = _smoke_fleet_sim(model, load, failures)
     summary["failures"] = failures
     print(json.dumps(summary, indent=2))
     return 1 if failures else 0
+
+
+def _smoke_fleet_sim(model, load: Sequence[LoadRequest],
+                     failures: List[str]) -> Dict[str, Any]:
+    """ISSUE 17 CI gates for the device-free fleet simulator
+    (serving/fleet_sim.py), two halves:
+
+    * sim-vs-engine agreement — the SAME small trace through a real
+      paged CPU engine and a SimEngine cloned from its cost model must
+      produce the IDENTICAL structural schedule: equal tick counts,
+      equal per-request token counts, byte-equal timeline signatures
+      and equal goodput (scheduling decisions are shared code and a
+      pure function of scheduler state, so the tolerance is exact;
+      only the clock domains differ — BASELINE.md "Simulated-clock
+      accounting conventions");
+
+    * fleet determinism — a small multi-replica heavy-tail scenario
+      replayed twice must produce byte-identical fleet signatures."""
+    from . import fleet_sim as _fs
+    from .engine import ServingEngine
+
+    kw = dict(num_slots=4, max_length=128, prefill_batch=2,
+              block_len=16)
+    eng = ServingEngine(model, paged=True, **kw)
+    spec = _fs.SimSpec.from_engine(eng)
+    er = replay(eng, load)
+    sr = replay(_fs.SimEngine(spec, **kw), load)
+    agree = {
+        "ticks": (er["ticks"], sr["ticks"]),
+        "token_counts_equal": (
+            [len(o) if o else 0 for o in er["outputs"]]
+            == [len(o) if o else 0 for o in sr["outputs"]]),
+        "signature_equal": er["signature"] == sr["signature"],
+        "goodput": (er["slo"]["goodput"], sr["slo"]["goodput"]),
+    }
+    if er["ticks"] != sr["ticks"]:
+        failures.append(
+            f"fleet_sim: tick-count disagreement with the real engine "
+            f"({er['ticks']} vs {sr['ticks']})")
+    if not agree["token_counts_equal"]:
+        failures.append(
+            "fleet_sim: per-request token counts disagree with the "
+            "real engine on the shared trace")
+    if not agree["signature_equal"]:
+        failures.append(
+            "fleet_sim: structural timeline disagrees with the real "
+            "engine on the shared trace")
+    if er["slo"]["goodput"] != sr["slo"]["goodput"]:
+        failures.append(
+            f"fleet_sim: goodput disagreement with the real engine "
+            f"({er['slo']['goodput']} vs {sr['slo']['goodput']})")
+    sigs = [
+        _fs.run_fleet(requests=300, replicas=4, num_slots=4,
+                      admission="predictive", seed=5)["signature"]
+        for _ in range(2)]
+    if len(set(sigs)) != 1:
+        failures.append("fleet_sim: fleet signature drift between "
+                        "identical-seed replays")
+    return dict(agree, fleet_signature_stable=len(set(sigs)) == 1)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

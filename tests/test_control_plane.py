@@ -1,0 +1,348 @@
+"""Cost-model-driven control plane (ISSUE 17): predictive SLO
+admission, the priced hold queue, replica autoscaling, and the
+device-free fleet simulator.
+
+The contracts under test: FLAGS_perf_model off means BYTE-IDENTICAL
+legacy placement (the predictive flag silently degrades — today's
+reactive policy IS the fallback); a drift finding disarms the gate the
+same way (an uncalibrated model must not gate admission); the hold
+queue ages out (priority classes outrank pricing, aging outranks both
+— no starvation); the autoscaler grows under predicted-SLO pressure
+and shrinks drain-before-retire; SimEngine replays the REAL scheduler
+tick-for-tick against the real engine on a shared trace; and the
+control-plane telemetry reaches the shared /metrics registry.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.serving import ReplicaRouter, ServingEngine
+from paddle_tpu.serving import fleet_sim as fs
+from paddle_tpu.serving import loadgen as lg
+from paddle_tpu.serving.admission import HoldQueue, place_verdict
+from paddle_tpu.serving.autoscaler import ReplicaAutoscaler
+
+MAXLEN = 64
+BL = 8
+
+_CP_KEYS = ("serving_admission", "serving_admission_slack",
+            "serving_admission_calib", "serving_admission_max_defer_ticks",
+            "serving_slo_ttft_ms", "serving_slo_tpot_ms",
+            "serving_autoscale_min_ticks", "serving_autoscale_cooldown",
+            "perf_model")
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    saved = flags.get_flags(_CP_KEYS)
+    yield
+    flags.set_flags(saved)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    pt.seed(7)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    return model
+
+
+def _prompt(n, seed):
+    return np.random.RandomState(seed).randint(0, 256, n).astype(np.int32)
+
+
+def _trace(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(_prompt(int(rng.randint(4, 12)), seed * 100 + i),
+             int(rng.randint(3, 7))) for i in range(n)]
+
+
+def _replay_router(lm, trace, **router_kw):
+    router = ReplicaRouter(lm, num_replicas=2, paged=True, block_len=BL,
+                           num_slots=2, max_length=MAXLEN,
+                           policy="least_loaded", **router_kw)
+    log = obs.get_request_log()
+    mark = log.mark()
+    rids = [router.submit(p, max_new_tokens=n) for p, n in trace]
+    out = dict(router.drain())
+    end = log.mark()
+    return ([out[r] for r in rids],
+            log.timeline_signature(since_uid=mark, until_uid=end))
+
+
+# -- hold queue ordering ---------------------------------------------------
+
+def test_hold_queue_pops_priority_then_price_then_arrival():
+    q = HoldQueue(max_defer_ticks=0)          # aging disabled
+    a = q.push("batch_cheap", priority=0, price=1.0)
+    b = q.push("batch_dear", priority=0, price=9.0)
+    c = q.push("interactive_dear", priority=5, price=9.0)
+    d = q.push("interactive_cheap", priority=5, price=1.0)
+    assert [e.payload for e in q.ordered()] == [
+        "interactive_cheap", "interactive_dear",
+        "batch_cheap", "batch_dear"]
+    q.remove(d)
+    assert [e.payload for e in q.ordered()] == [
+        "interactive_dear", "batch_cheap", "batch_dear"]
+    assert a.seq < b.seq < c.seq
+
+
+def test_hold_queue_aging_beats_priority_and_price():
+    """An entry past the starvation bound jumps the WHOLE line — in
+    arrival order among the aged — so a stream of cheap high-priority
+    arrivals can never starve a parked expensive batch request."""
+    q = HoldQueue(max_defer_ticks=3)
+    old = q.push("old_batch", priority=0, price=99.0)
+    for t in range(3):
+        q.tick()
+        q.push(f"fresh_hi_{t}", priority=5, price=0.0)
+    assert q.aged(old)
+    assert q.ordered()[0].payload == "old_batch"
+    # two aged entries pop FIFO among themselves, not by price
+    q2 = HoldQueue(max_defer_ticks=1)
+    first = q2.push("first_dear", priority=0, price=50.0)
+    second = q2.push("second_cheap", priority=5, price=0.0)
+    q2.tick()
+    assert q2.aged(first) and q2.aged(second)
+    assert [e.payload for e in q2.ordered()] == [
+        "first_dear", "second_cheap"]
+
+
+# -- fallback contracts ----------------------------------------------------
+
+def test_perf_model_off_is_byte_identical_legacy_placement(lm):
+    """FLAGS_perf_model off: the 'predictive' admission flag must
+    silently degrade to the reactive queue-depth policy — identical
+    outputs AND a byte-identical structural timeline (same placements,
+    same tick schedule, no defer/hold events)."""
+    trace = _trace(n=8, seed=1)
+    flags.set_flags({"perf_model": "off",
+                     "serving_slo_ttft_ms": 1.0,   # deadlines armed...
+                     "serving_slo_tpot_ms": 1.0})  # ...but no model
+    flags.set_flags({"serving_admission": "queue_depth"})
+    out_legacy, sig_legacy = _replay_router(lm, trace)
+    flags.set_flags({"serving_admission": "predictive"})
+    out_pred, sig_pred = _replay_router(lm, trace)
+    assert out_pred == out_legacy
+    assert sig_pred == sig_legacy
+
+
+def test_drift_finding_disarms_gate_conservatively(lm):
+    """A cost-model drift finding must disarm the predictive gate on
+    that engine — and one drifting replica disarms the whole router
+    (predictions that left their calibrated band cannot rank
+    candidates)."""
+    flags.set_flags({"serving_admission": "predictive",
+                     "perf_model": "on",
+                     "serving_slo_tpot_ms": 50.0})
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        paged=True, block_len=BL)
+    assert eng._perf is not None
+    assert eng.admission_armed()
+    router = ReplicaRouter(engines=[
+        eng, ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                           paged=True, block_len=BL)],
+        policy="least_loaded")
+    assert router._predictive_armed()
+    with eng._perf._lock:                     # inject a drift finding
+        eng._perf._drift["weight"] = {
+            "bound": "weight", "tick": 1, "ewma": 9.0,
+            "baseline": 1.0, "lo": 0.5, "hi": 1.5}
+    assert not eng.admission_armed()
+    assert not router._predictive_armed()
+    # the fallback must still SERVE: placement degrades to least-loaded
+    rid = router.submit(_prompt(6, 3), max_new_tokens=4)
+    out = dict(router.drain())
+    assert len(out[rid]) == 4
+
+
+def test_place_verdict_admits_without_deadline_or_model(lm):
+    flags.set_flags({"perf_model": "on",
+                     "serving_slo_ttft_ms": 0.0,
+                     "serving_slo_tpot_ms": 0.0})
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        paged=True, block_len=BL)
+    v = place_verdict(eng, 8)                 # no deadline armed
+    assert v.verdict == "admit" and v.reason == "no_deadline"
+    flags.set_flags({"perf_model": "off"})
+    e2 = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                       paged=True, block_len=BL)
+    v2 = place_verdict(e2, 8, ttft_slo_ms=1.0, tpot_slo_ms=1.0)
+    assert v2.verdict == "admit" and v2.reason == "no_model"
+
+
+# -- deferral liveness -----------------------------------------------------
+
+def test_predictive_deferral_never_starves_and_finishes_all():
+    """Under an impossibly tight TPOT SLO every placement prices over
+    the deadline — requests defer into the hold queue, age past
+    FLAGS_serving_admission_max_defer_ticks, and are force-placed.
+    Everything must still finish, interactive (priority 5) popping
+    ahead of batch among the held."""
+    flags.set_flags({"serving_admission": "predictive",
+                     "perf_model": "on",
+                     "serving_admission_max_defer_ticks": 4,
+                     "serving_slo_ttft_ms": 0.0,
+                     "serving_slo_tpot_ms": 1e-6})
+    fleet = fs.FleetSim(2, fs.SimSpec.default(), seed=0, num_slots=2,
+                        max_length=MAXLEN, block_len=BL)
+    rids = []
+    for i in range(6):
+        rids.append(fleet.submit(_prompt(6, 10 + i), max_new_tokens=3,
+                                 priority=5 if i % 3 == 0 else 0))
+    decisions_before = (fleet.router.metrics()["aggregate"]
+                       ["control_plane"]["decisions"])
+    assert decisions_before.get("defer", 0) > 0   # the gate engaged
+    out = dict(fleet.drain())
+    assert sorted(out) == sorted(rids)
+    assert all(len(v) == 3 for v in out.values())
+    assert fleet.router.pending_held == 0
+
+
+# -- autoscaler ------------------------------------------------------------
+
+def _autoscale_run():
+    flags.set_flags({"serving_admission": "predictive",
+                     "perf_model": "on",
+                     "serving_slo_ttft_ms": 0.0,
+                     "serving_slo_tpot_ms": 40.0,
+                     "serving_autoscale_min_ticks": 3,
+                     "serving_autoscale_cooldown": 5})
+    spec = fs.SimSpec.default()
+    fleet = fs.FleetSim(2, spec, seed=0, num_slots=4, max_length=512)
+    scaler = ReplicaAutoscaler(
+        fleet.router, min_replicas=2, max_replicas=5,
+        engine_factory=lambda: fs.SimEngine(spec, num_slots=4,
+                                            max_length=512, seed=99))
+    trace = lg.generate_load(
+        fs.fleet_load_spec(150, replicas=2, num_slots=4), seed=3)
+    it = iter(trace)
+    nxt, t = next(it, None), 0.0
+    while (nxt is not None or fleet.router.pending_held
+           or any(not fleet.router.replica_empty(i)
+                  for i in fleet.router.live_replicas)):
+        while nxt is not None and nxt.arrival <= t:
+            fleet.submit(nxt.prompt, max_new_tokens=nxt.max_new_tokens)
+            nxt = next(it, None)
+        fleet.step()
+        scaler.observe()
+        t += 1.0
+    for _ in range(200):                      # idle tail: drain + retire
+        fleet.step()
+        scaler.observe()
+    return scaler.report()
+
+
+def test_autoscaler_grows_then_drains_then_retires():
+    rep = _autoscale_run()
+    kinds = [a["action"] for a in rep["actions"]]
+    assert "add" in kinds                     # pressure grew the fleet
+    assert "drain" in kinds and "retire" in kinds
+    # drain-before-retire: every retire follows a drain of the SAME
+    # replica, and the replica was EMPTY at retirement (sessions never
+    # migrate — the router raises otherwise, so reaching here proves it)
+    drained = set()
+    for a in rep["actions"]:
+        if a["action"] == "drain":
+            drained.add(a["replica"])
+        elif a["action"] == "retire":
+            assert a["replica"] in drained
+    assert rep["live_replicas"] >= 2          # never below min_replicas
+
+
+def test_autoscaler_action_trace_is_deterministic():
+    assert _autoscale_run()["actions"] == _autoscale_run()["actions"]
+
+
+def test_autoscaler_never_retires_below_min():
+    flags.set_flags({"serving_autoscale_min_ticks": 1,
+                     "serving_autoscale_cooldown": 0,
+                     "perf_model": "on"})
+    fleet = fs.FleetSim(2, fs.SimSpec.default(), seed=0, num_slots=4,
+                        max_length=128)
+    scaler = ReplicaAutoscaler(fleet.router, min_replicas=2)
+    for _ in range(50):                       # pure slack, no work
+        fleet.step()
+        scaler.observe()
+    assert len(fleet.router.live_replicas) == 2
+    assert not fleet.router._draining
+
+
+# -- fleet simulator -------------------------------------------------------
+
+def test_fleet_sim_replays_byte_stable():
+    r1 = fs.run_fleet(requests=300, replicas=4, num_slots=4,
+                      admission="predictive", seed=5)
+    r2 = fs.run_fleet(requests=300, replicas=4, num_slots=4,
+                      admission="predictive", seed=5)
+    assert r1["signature"] == r2["signature"]
+    assert r1["ticks"] == r2["ticks"]
+    assert r1["goodput"] is not None
+
+
+def test_sim_engine_agrees_with_real_engine(lm):
+    """SimEngine runs the REAL scheduler: on a shared trace the real
+    paged engine and the sim must agree tick-for-tick — same tick
+    count, same per-request token counts, byte-identical structural
+    timeline (exact tolerance: zero)."""
+    flags.set_flags({"serving_admission": "queue_depth",
+                     "perf_model": "on"})
+    trace = _trace(n=6, seed=2)
+    log = obs.get_request_log()
+
+    def replay(eng):
+        mark = log.mark()
+        rids = [eng.submit(p, max_new_tokens=n) for p, n in trace]
+        out = dict(eng.drain())
+        end = log.mark()
+        return ([len(out[r]) for r in rids], eng._ticks,
+                log.timeline_signature(since_uid=mark, until_uid=end))
+
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        paged=True, block_len=BL)
+    sim = fs.SimEngine(fs.SimSpec.from_engine(eng), num_slots=2,
+                       max_length=MAXLEN, block_len=BL)
+    counts_e, ticks_e, sig_e = replay(eng)
+    counts_s, ticks_s, sig_s = replay(sim)
+    assert counts_s == counts_e
+    assert ticks_s == ticks_e
+    assert sig_s == sig_e
+
+
+def test_sim_engine_rejects_unsupported_modes():
+    flags.set_flags({"serving_chunked_prefill": True})
+    with pytest.raises(NotImplementedError):
+        fs.SimEngine(fs.SimSpec.default())
+    flags.set_flags({"serving_chunked_prefill": False})
+
+
+# -- telemetry -------------------------------------------------------------
+
+def test_admission_telemetry_reaches_metrics_registry():
+    """router.admission_decision{verdict=...} counters and the
+    router.predicted_tpot_ms per-replica gauge must land on the shared
+    registry the PR-15 /metrics server exposes."""
+    flags.set_flags({"serving_admission": "predictive",
+                     "perf_model": "on",
+                     "serving_slo_ttft_ms": 0.0,
+                     "serving_slo_tpot_ms": 1e-6})   # defer everything
+    fleet = fs.FleetSim(2, fs.SimSpec.default(), seed=0, num_slots=2,
+                        max_length=MAXLEN, block_len=BL)
+    rid = fleet.submit(_prompt(6, 40), max_new_tokens=3, priority=1)
+    fleet.submit(_prompt(7, 41), max_new_tokens=3)
+    fleet.drain()
+    text = obs.default_registry().prometheus_text()
+    assert "router_admission_decision" in text
+    assert 'verdict="defer"' in text
+    assert 'verdict="admit"' in text
+    assert "router_predicted_tpot_ms" in text
+    assert "serving_admission_deferred" in text
+    decisions = (fleet.router.metrics()["aggregate"]["control_plane"]
+                 ["decisions"])
+    assert decisions.get("defer", 0) >= 1
+    assert decisions.get("admit", 0) >= 2
+    assert len(fleet.result(rid)) == 3
