@@ -2344,6 +2344,243 @@ def _control_plane_bench(model, on_tpu):
                      "seeded from measured rows)"}}
 
 
+def _disagg_serving_bench(model, on_tpu):
+    """Disaggregated prefill/decode A/B over the multi-host plane
+    (ISSUE 18): the SAME seeded loadgen trace — a decode cohort (short
+    prompts, long outputs) hit mid-stream by heavy prefill arrivals
+    (long prompts, two tokens) — driven through two 2-worker planes
+    over LoopbackTransport.  A = colocated (``policy='prefix'``: both
+    workers take mixed work), B = disaggregated (``policy='disagg'``:
+    w0 prefills, every request migrates to w1 after its first token
+    via export_blocks/import_blocks over the transport).
+
+    Clocks: each worker runs on a PRIVATE simulated clock advanced by
+    its OWN work per tick (base + per-prefill-token + per-decode-token
+    costs).  That models separate hosts — wall clocks don't share
+    stalls — which is the thing disaggregation buys: in-process both
+    engines step sequentially on one wall clock, so a decode worker
+    would be charged for the other host's prefill burn and the win
+    could never show.  The engines stamp ttft/tpot through
+    ``engine._clock``, so the retired ``tpot_ms`` attrs ARE sim-clock
+    readings and the whole A/B is device-free deterministic
+    (BASELINE.md 'Multi-host accounting conventions').
+
+    Gates banked for --check-history: decode-cohort TPOT p99 strictly
+    better disaggregated, token-identical outputs across arms,
+    migration bytes accounted (> 0, one migration per decode-cohort
+    request — a two-token heavy prefill retires inside its own wave
+    step and never opens a migration window), byte-stable replay of
+    BOTH arms, step_traces <= 1, zero lint findings."""
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import LoadSpec, ServingEngine, generate_load
+    from paddle_tpu.serving.multihost import (EngineWorker,
+                                              LoopbackTransport,
+                                              MultiHostRouter)
+
+    # fresh registry: jit.traces carries one child per (engine, site)
+    # and earlier sections' engines can push the family past
+    # metrics_max_children — the overflow child would MERGE this
+    # section's step_traces across engines (the loadgen --smoke hazard)
+    obs.reset()
+    log = obs.get_request_log()
+
+    if on_tpu:
+        slots, max_len, bl, nb = 8, 2048, 64, 192
+        p_short, p_long, out_dec, out_pre = 16, 1024, 64, 4
+        n_dec, n_pre = 6, 10
+    else:  # plumbing smoke: tiny trace, sim-clock numbers still real
+        slots, max_len, bl, nb = 4, 160, 8, 96
+        p_short, p_long, out_dec, out_pre = 8, 96, 24, 2
+        n_dec, n_pre = 4, 6
+    seed = 13
+    vocab = model.config.vocab_size
+
+    def _cls_spec(n, plen, out):
+        # single-bucket zipf pins both lengths: the class IS the shape
+        return LoadSpec(n_requests=n, vocab=vocab,
+                        arrival="poisson", mean_gap=1.0,
+                        prompt_dist="zipf", prompt_buckets=(plen,),
+                        prompt_min=plen, prompt_max=plen,
+                        output_dist="zipf", output_buckets=(out,),
+                        output_min=out, output_max=out,
+                        tenants=1, shared_prefix_len=0)
+
+    trace = []
+    for r in generate_load(_cls_spec(n_dec, p_short, out_dec), seed=seed):
+        trace.append({"arrival": r.arrival, "prompt": r.prompt,
+                      "max_new": r.max_new_tokens, "cls": "decode"})
+    for r in generate_load(_cls_spec(n_pre, p_long, out_pre),
+                           seed=seed + 1):
+        # heavy prefills land while the decode cohort is mid-stream
+        trace.append({"arrival": r.arrival + 2.0, "prompt": r.prompt,
+                      "max_new": r.max_new_tokens, "cls": "prefill"})
+    order = sorted(range(len(trace)),
+                   key=lambda i: (trace[i]["arrival"], i))
+
+    cost = {"base_ms": 0.5, "prefill_ms_per_token": 0.05,
+            "decode_ms_per_token": 0.05}
+
+    class _ClockedWorker(EngineWorker):
+        """EngineWorker whose engine reads a private simulated clock,
+        advanced by this worker's OWN work each tick.  Imported
+        requests arrive with their KV built, so they never pay the
+        prefill charge here."""
+
+        def __init__(self, engine, name):
+            super().__init__(engine, name)
+            self._now_s = 0.0
+            engine._clock = lambda: self._now_s
+            self._plen = {}
+            self._prefilled = set()
+
+        def _rpc_submit(self, payload):
+            out = super()._rpc_submit(payload)
+            self._plen[out["rid"]] = len(payload["prompt"])
+            return out
+
+        def _rpc_import_request(self, payload):
+            out = super()._rpc_import_request(payload)
+            if out["rid"] is not None:
+                self._prefilled.add(out["rid"])
+            return out
+
+        def _rpc_step(self, payload):
+            out = super()._rpc_step(payload)
+            c = cost["base_ms"]
+            for rid_s, toks in out["deltas"].items():
+                rid = int(rid_s)
+                if rid not in self._prefilled:
+                    self._prefilled.add(rid)
+                    c += (cost["prefill_ms_per_token"]
+                          * self._plen.get(rid, 0))
+                c += cost["decode_ms_per_token"] * len(toks)
+            self._now_s += c * 1e-3
+            return out
+
+    def mk_plane(policy, prefill=None):
+        from collections import OrderedDict
+        workers, engines = OrderedDict(), []
+        for i in range(2):
+            eng = ServingEngine(model, num_slots=slots,
+                                max_length=max_len, prefill_batch=2,
+                                paged=True, block_len=bl, num_blocks=nb)
+            engines.append(eng)
+            w = _ClockedWorker(eng, name=f"w{i}")
+            workers[f"w{i}"] = LoopbackTransport(w.handle, name=f"w{i}")
+        return MultiHostRouter(workers, policy=policy,
+                               prefill=prefill), engines
+
+    def drive(plane):
+        mark = log.mark()
+        rids = {}
+        tick = nxt = 0
+        t0 = time.perf_counter()
+        while (nxt < len(order) or plane.queue_depth or plane.num_active
+               or plane.num_pending or plane.num_preempted):
+            while (nxt < len(order)
+                   and trace[order[nxt]]["arrival"] <= tick):
+                i = order[nxt]
+                try:
+                    rids[i] = plane.submit(
+                        trace[i]["prompt"],
+                        max_new_tokens=trace[i]["max_new"])
+                except ValueError:
+                    break                 # re-admit at the door next tick
+                nxt += 1
+            plane.step()
+            tick += 1
+        end_mark = log.mark()
+        outputs = [plane.result(rids[i]) if i in rids else None
+                   for i in range(len(trace))]
+        return {"mark": mark, "end_mark": end_mark, "ticks": tick,
+                "outputs": outputs,
+                "host_wall_s": round(time.perf_counter() - t0, 3),
+                "uids": {i: plane.request_uid(rids[i]) for i in rids},
+                "signature": log.timeline_signature(
+                    since_uid=mark, until_uid=end_mark)}
+
+    def tpot_p99(rep, cls):
+        uids = {rep["uids"][i] for i in rep["uids"]
+                if trace[i]["cls"] == cls}
+        vals = []
+        for uid, evs in log.records(rep["mark"], rep["end_mark"]).items():
+            if uid not in uids:
+                continue
+            ret = next((e["attrs"] for e in evs
+                        if e["name"] == "retired"), None)
+            if ret and ret.get("tpot_ms") is not None:
+                vals.append(float(ret["tpot_ms"]))
+        return round(float(np.percentile(vals, 99)), 4) if vals else None
+
+    def run(policy, prefill=None):
+        plane, engines = mk_plane(policy, prefill)
+        rep = drive(plane)
+        rep["aggregate"] = plane.metrics()["aggregate"]
+        rep["step_traces"] = max(e.step_traces for e in engines)
+        rep["lint_findings"] = sum(len(e.lint_step()) for e in engines)
+        plane.shutdown()
+        return rep
+
+    a1 = run("prefix")                    # A: colocated
+    a2 = run("prefix")                    # A again: replay stability
+    b1 = run("disagg", prefill=["w0"])    # B: disaggregated
+    b2 = run("disagg", prefill=["w0"])    # B again
+
+    a_p99, b_p99 = tpot_p99(a1, "decode"), tpot_p99(b1, "decode")
+    complete = all(o for o in a1["outputs"]) and all(
+        o for o in b1["outputs"])
+    identical = complete and a1["outputs"] == b1["outputs"]
+    deterministic = (a1["signature"] == a2["signature"]
+                     and a1["outputs"] == a2["outputs"]
+                     and b1["signature"] == b2["signature"]
+                     and b1["outputs"] == b2["outputs"])
+    agg = b1["aggregate"]
+    mig, mig_bytes = int(agg["migrations"]), int(agg["migration_bytes"])
+
+    def _row(rep, p99):
+        return {"ticks": rep["ticks"],
+                "decode_tpot_p99_ms_sim": p99,
+                "prefill_tpot_p99_ms_sim": tpot_p99(rep, "prefill"),
+                "migrations": int(rep["aggregate"]["migrations"]),
+                "migration_bytes": int(
+                    rep["aggregate"]["migration_bytes"]),
+                "step_traces": rep["step_traces"],
+                "lint_findings": rep["lint_findings"],
+                "host_wall_s": rep["host_wall_s"]}
+
+    return {
+        "trace": {"seed": seed, "decode_requests": n_dec,
+                  "heavy_prefills": n_pre, "prompt_short": p_short,
+                  "prompt_long": p_long, "decode_output": out_dec,
+                  "prefill_output": out_pre},
+        "sim_cost_model": cost,
+        "colocated": _row(a1, a_p99),
+        "disaggregated": _row(b1, b_p99),
+        "decode_tpot_strictly_better": bool(
+            a_p99 is not None and b_p99 is not None and b_p99 < a_p99),
+        "outputs_token_identical": bool(identical),
+        "migrations_cover_decode_cohort": bool(mig >= n_dec),
+        "migration_bytes_per_request": (round(mig_bytes / mig, 1)
+                                        if mig else 0.0),
+        "deterministic_replay": bool(deterministic),
+        "step_traces": max(a1["step_traces"], b1["step_traces"]),
+        "lint_findings": a1["lint_findings"] + b1["lint_findings"],
+        "note": "per-worker simulated clocks (separate hosts don't "
+                "share stalls); migration bytes are transport traffic "
+                "(export_blocks payload), never streamed-KV bytes — "
+                "BASELINE.md 'Multi-host accounting conventions'",
+        "tpu_recheck": None if on_tpu else {
+            "status": "pending_tpu",
+            "command": "bench.py --sections disagg_serving",
+            "claim": "the sim-clock A/B holds on real chips: decode "
+                     "TPOT p99 under concurrent heavy prefill improves "
+                     "once prefill burn moves off the decode workers, "
+                     "token outputs stay identical (export/import "
+                     "moves exact KV blocks)"}}
+
+
 def _merge_decode_artifact(section_key, section):
     """Incremental write: each finished section lands on disk immediately,
     so a wedged later section (tunnel RPC hangs are real — round 5) never
@@ -2408,7 +2645,7 @@ def run_decode_bench(args):
     if want & {"prefill", "decode", "int8", "e2e", "serving",
                "spec_decode", "mesh_serving", "slo_serving",
                "int8_serving", "perf_model", "preempt_serving",
-               "control_plane"}:
+               "control_plane", "disagg_serving"}:
         model, params, n = _decode_model(max_pos=8192 if on_tpu else 512,
                                          on_tpu=on_tpu)
         pbytes = n * 2                                  # bf16 weights
@@ -2665,6 +2902,23 @@ def run_decode_bench(args):
               f"{fl['host_wall_s']} s host / {fl['sim_wall_s']} s sim",
               file=sys.stderr)
 
+    # -- disaggregated prefill/decode over the multi-host plane ----------
+    if "disagg_serving" in want:
+        print("[decode-bench] disaggregated serving A/B ...",
+              file=sys.stderr)
+        ds = _disagg_serving_bench(model, on_tpu)
+        _merge_decode_artifact(skey, {"disagg_serving": ds})
+        print(f"disagg_serving: decode TPOT p99 (sim) colocated "
+              f"{ds['colocated']['decode_tpot_p99_ms_sim']} ms vs "
+              f"disagg {ds['disaggregated']['decode_tpot_p99_ms_sim']} "
+              f"ms (strictly better "
+              f"{ds['decode_tpot_strictly_better']}), token-identical "
+              f"{ds['outputs_token_identical']}, "
+              f"{ds['disaggregated']['migrations']} migrations / "
+              f"{ds['disaggregated']['migration_bytes']} bytes, "
+              f"deterministic {ds['deterministic_replay']}",
+              file=sys.stderr)
+
     # -- mesh-sharded serving: mp engine + dp router A/B -----------------
     if "mesh_serving" in want:
         print("[decode-bench] mesh serving A/B ...", file=sys.stderr)
@@ -2827,7 +3081,10 @@ def main():
                          "preempt+recompute under a tight pool) and the "
                          "'control_plane' predictive-admission A/B + "
                          "replica-autoscaler trace + device-free fleet-"
-                         "simulator scale row; implies --decode")
+                         "simulator scale row and the 'disagg_serving' "
+                         "colocated-vs-disaggregated multi-host plane "
+                         "A/B on per-worker simulated clocks; implies "
+                         "--decode")
     ap.add_argument("--check-history", action="store_true",
                     dest="check_history",
                     help="perf-regression gate: validate the committed "

@@ -435,3 +435,23 @@ DEFINE("metrics_max_children", 64,
        "new label sets into a single {overflow='true'} child, so "
        "per-uid or per-shape labels can never grow the registry "
        "unboundedly")
+DEFINE("multihost_call_timeout_s", 5.0,
+       "per-RPC-call timeout for the multi-host serving plane's socket "
+       "transport (serving/multihost): a call past this deadline counts "
+       "as transport loss and feeds the heartbeat/failover path")
+DEFINE("multihost_call_retries", 2,
+       "reconnect attempts per RPC call (deterministic exponential "
+       "backoff); only idempotent methods — ping/status/result/... — "
+       "are ever replayed blind after a broken connection")
+DEFINE("multihost_retry_backoff_s", 0.05,
+       "base of the deterministic exponential backoff between RPC "
+       "reconnect attempts (base * 2**attempt seconds)")
+DEFINE("multihost_heartbeat_every", 4,
+       "plane scheduler ticks between heartbeat pings to every worker; "
+       "counted in ticks (not wall time) so loopback replays stay "
+       "byte-deterministic.  A failed ping marks the worker lost and "
+       "re-admits its sessions on the survivors (recompute-from-prefix)")
+DEFINE("multihost_stream_poll_s", 0.002,
+       "frontend step-loop idle sleep between scheduler ticks while "
+       "streaming /v1/generate responses (real-time mode only; tests "
+       "drive the plane tick-by-tick instead)")

@@ -8,7 +8,14 @@ operator, with zero dependencies beyond ``http.server``:
     anomaly totals, drift-finding counts (a load balancer's readiness
     answer in one GET),
   * ``/requests`` — the RequestLog's most recent timelines as JSON
-    (``?n=`` caps the tail, default 32 requests).
+    (``?n=``/``?limit=`` caps the tail, default 32, hard cap 1024;
+    ``?uid=`` returns ONE request's full lifecycle timeline — the
+    operator's "what happened to request X" answer, spanning routers,
+    failovers and migrations because the uid is minted once),
+  * ``/v1/generate`` — POST; present only when the server was built
+    with a ``generator`` (the multi-host front end).  Streams JSON
+    lines over a chunked response: tokens go on the wire the tick
+    they surface, not at retirement.
 
 Off by default: ``FLAGS_metrics_port`` 0 disables it, a positive port
 binds it, and ``-1`` binds an ephemeral port (tests read
@@ -55,13 +62,48 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, body.encode(), "application/json")
         elif url.path == "/requests":
             q = parse_qs(url.query)
-            n = int(q.get("n", ["32"])[0])
+            if "uid" in q:
+                payload = owner.request_timeline(int(q["uid"][0]))
+                code = 200 if payload["found"] else 404
+                body = json.dumps(payload, sort_keys=True, default=str)
+                self._send(code, body.encode(), "application/json")
+                return
+            n = int(q.get("limit", q.get("n", ["32"]))[0])
             body = json.dumps(owner.request_tail(n), sort_keys=True,
                               default=str)
             self._send(200, body.encode(), "application/json")
         else:
             self._send(404, b'{"error": "not found"}\n',
                        "application/json")
+
+    def do_POST(self) -> None:                  # noqa: N802 (stdlib API)
+        owner: "ExpositionServer" = self.server.owner  # type: ignore
+        url = urlparse(self.path)
+        if url.path != "/v1/generate" or owner.generator is None:
+            self._send(404, b'{"error": "not found"}\n',
+                       "application/json")
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, b'{"error": "bad json"}\n',
+                       "application/json")
+            return
+        # chunked transfer: one JSON line per flush, flushed per tick
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for chunk in owner.generator.stream(payload):
+                data = (json.dumps(chunk, sort_keys=True) + "\n").encode()
+                self.wfile.write(b"%x\r\n" % len(data))
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            return                              # client went away
+        self.wfile.write(b"0\r\n\r\n")
 
 
 class ExpositionServer:
@@ -74,13 +116,17 @@ class ExpositionServer:
     def __init__(self, port: Optional[int] = None,
                  registry: Optional[_metrics.MetricsRegistry] = None,
                  engines: Optional[List[Any]] = None,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 generator: Optional[Any] = None) -> None:
         if port is None:
             port = int(_flags.flag("metrics_port"))
         self._requested_port = int(port)
         self.registry = registry or _metrics.default_registry()
         self.engines = list(engines or [])
         self.host = host
+        # duck-typed streaming back end: anything with
+        # ``stream(payload) -> Iterator[dict]`` enables POST /v1/generate
+        self.generator = generator
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -123,10 +169,18 @@ class ExpositionServer:
                 "engines": engines}
 
     def request_tail(self, n: int = 32) -> Dict[str, Any]:
+        n = min(max(0, int(n)), 1024)           # bounded: never a full dump
         recs = get_request_log().records()
-        uids = sorted(recs)[-max(0, int(n)):]
+        uids = sorted(recs)[-n:] if n else []
         return {"requests": {str(u): recs[u] for u in uids},
-                "total": len(recs)}
+                "total": len(recs), "limit": n}
+
+    def request_timeline(self, uid: int) -> Dict[str, Any]:
+        """ONE request's lifecycle — the ``?uid=`` single-timeline
+        lookup.  Because uids are minted once plane-side, this is the
+        whole story across placement, migration and failover."""
+        tl = get_request_log().timeline(int(uid))
+        return {"uid": int(uid), "found": bool(tl), "events": tl}
 
     # -- lifecycle -----------------------------------------------------
 

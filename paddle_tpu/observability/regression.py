@@ -307,6 +307,30 @@ def check_history(root: Optional[str] = None,
             f"decision_signature_stable="
             f"{ps.get('preempt_signature_stable')}"))
 
+    # disagg_serving (ISSUE 18): the committed multi-host A/B must keep
+    # the disaggregation win — decode-cohort TPOT p99 strictly better
+    # with prefill burn moved off the decode worker, token-identical
+    # outputs across arms, migration bytes actually accounted (every
+    # decode-cohort request migrated, bytes > 0), and byte-stable
+    # replay of both arms
+    ds = cpu.get("disagg_serving", {})
+    if ds:
+        mig = ds.get("disaggregated", {})
+        ok = (bool(ds.get("decode_tpot_strictly_better"))
+              and bool(ds.get("outputs_token_identical"))
+              and bool(ds.get("migrations_cover_decode_cohort"))
+              and int(mig.get("migration_bytes", 0)) > 0
+              and bool(ds.get("deterministic_replay")))
+        checks.append(_check(
+            "disagg_serving_row", ok,
+            f"tpot_strictly_better="
+            f"{ds.get('decode_tpot_strictly_better')} "
+            f"token_identical={ds.get('outputs_token_identical')} "
+            f"migrations_cover_decode_cohort="
+            f"{ds.get('migrations_cover_decode_cohort')} "
+            f"migration_bytes={mig.get('migration_bytes')} "
+            f"deterministic={ds.get('deterministic_replay')}"))
+
     # control_plane (ISSUE 17): predictive admission must hold its
     # committed win — goodput at-or-above the reactive baseline with a
     # strict win on >= 1 SLO class, token-identity where both arms

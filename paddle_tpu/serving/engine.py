@@ -575,49 +575,17 @@ class ServingEngine:
                    if self.mesh is not None else {}))
             self.kv.on_demote = self._pending_demote.extend
         self._tick_swap_bytes = 0      # host<->HBM bytes moved this tick
-        if self.paged and self._host_blocks > 0:
-            # host-tier block movers (swap-out reads / swap-in writes one
-            # pool block), each jitted ONCE with a traced block id — a
-            # different block is different DATA, not a different trace,
-            # so the retrace budget of 1 holds for every swap volume.
-            # The read fn does NOT donate (the pool is read again); the
-            # write fn donates the pool and the engine rebinds it, same
-            # aliasing contract as the step.  Both map over the cache
-            # pytree, so the int8 {kv, scale} pool moves a block's scale
-            # row together with its payload — a swap round trip restores
-            # quantized blocks bit-for-bit.
-            def _read_block_impl(c, bid):
-                return jax.tree_util.tree_map(
-                    lambda a: jax.lax.dynamic_slice_in_dim(
-                        a, bid, 1, axis=2), c)
-
-            def _write_block_impl(c, payload, bid):
-                return jax.tree_util.tree_map(
-                    lambda a, p: jax.lax.dynamic_update_slice_in_dim(
-                        a, p, bid, axis=2), c, payload)
-            read_kwargs, write_kwargs = {}, {}
-            if self.mesh is not None:
-                # the one-block payload keeps the pool's per-leaf specs
-                # (only the head dim is sharded; the block axis never is,
-                # so a single-block slice stays on-device-local)
-                sh = self._mesh_jit_shardings(2, 1, cache_argnum=0,
-                                              with_params=False)
-                read_kwargs = dict(in_shardings=sh["in_shardings"],
-                                   out_shardings=sh["out_shardings"])
-                write_kwargs = dict(
-                    in_shardings=(sh["in_shardings"][0],
-                                  sh["out_shardings"],
-                                  sh["in_shardings"][1]),
-                    out_shardings=sh["out_shardings"])
-            self._read_block_fn = _obs.track_retraces(
-                _read_block_impl, "serving.swap_read", budget=1,
-                labels={"engine": self._eid}, **read_kwargs)
-            self._write_block_fn = _obs.track_retraces(
-                _write_block_impl, "serving.swap_write", budget=1,
-                labels={"engine": self._eid}, donate_argnums=(0,),
-                **write_kwargs)
-            self.kv.on_swap_out = self._host_swap_out
-            self.kv.on_swap_in = self._host_swap_in
+        if self.paged:
+            # block movers are built on first use (_block_movers): the
+            # host tier's swap hooks AND the ISSUE-18 export/import
+            # migration path share them, but an engine that never swaps
+            # or migrates must not spend two jit.traces counter children
+            # on them (per-engine label cardinality is capped)
+            self._read_block_fn = None
+            self._write_block_fn = None
+            if self._host_blocks > 0:
+                self.kv.on_swap_out = self._host_swap_out
+                self.kv.on_swap_in = self._host_swap_in
 
         # host-side mirrors of the step inputs (tiny; re-uploaded per tick)
         s = self.num_slots
@@ -1105,6 +1073,23 @@ class ServingEngine:
             "serving.cancelled",
             "cancel() calls that found and tore down a live "
             "request").labels(**lbl)
+        # cross-worker KV migration (ISSUE 18; BASELINE.md "Multi-host
+        # accounting conventions": migration bytes are pool traffic over
+        # the transport, NEVER streamed-KV bytes and NEVER swap bytes)
+        self._m_mig_out = ctr(
+            "migration.requests_out",
+            "requests exported for cross-worker migration").labels(**lbl)
+        self._m_mig_in = ctr(
+            "migration.requests_in",
+            "migration records imported into this engine").labels(**lbl)
+        self._m_mig_bytes_out = ctr(
+            "migration.bytes_out",
+            "KV payload bytes serialized out by export_request "
+            "(block payloads + scale rows)").labels(**lbl)
+        self._m_mig_bytes_in = ctr(
+            "migration.bytes_in",
+            "KV payload bytes written into the pool by "
+            "import_request").labels(**lbl)
 
     # -- jitted device programs -------------------------------------------
 
@@ -1514,12 +1499,57 @@ class ServingEngine:
 
     # -- host tier plumbing (swap hooks) -----------------------------------
 
+    def _block_movers(self):
+        """Build (once, lazily) the jitted one-block movers the swap
+        hooks and the export/import migration path share.  Each is
+        jitted ONCE with a traced block id — a different block is
+        different DATA, not a different trace, so the retrace budget of
+        1 holds for every swap/migration volume.  The read fn does NOT
+        donate (the pool is read again); the write fn donates the pool
+        and the engine rebinds it, the step's aliasing contract.  Both
+        map over the cache pytree, so the int8 {kv, scale} pool moves a
+        block's scale row together with its payload — a round trip
+        restores quantized blocks bit-for-bit."""
+        if self._read_block_fn is not None:
+            return
+        def _read_block_impl(c, bid):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, bid, 1, axis=2), c)
+
+        def _write_block_impl(c, payload, bid):
+            return jax.tree_util.tree_map(
+                lambda a, p: jax.lax.dynamic_update_slice_in_dim(
+                    a, p, bid, axis=2), c, payload)
+        read_kwargs, write_kwargs = {}, {}
+        if self.mesh is not None:
+            # the one-block payload keeps the pool's per-leaf specs
+            # (only the head dim is sharded; the block axis never is,
+            # so a single-block slice stays on-device-local)
+            sh = self._mesh_jit_shardings(2, 1, cache_argnum=0,
+                                          with_params=False)
+            read_kwargs = dict(in_shardings=sh["in_shardings"],
+                               out_shardings=sh["out_shardings"])
+            write_kwargs = dict(
+                in_shardings=(sh["in_shardings"][0],
+                              sh["out_shardings"],
+                              sh["in_shardings"][1]),
+                out_shardings=sh["out_shardings"])
+        self._read_block_fn = _obs.track_retraces(
+            _read_block_impl, "serving.swap_read", budget=1,
+            labels={"engine": self._eid}, **read_kwargs)
+        self._write_block_fn = _obs.track_retraces(
+            _write_block_impl, "serving.swap_write", budget=1,
+            labels={"engine": self._eid}, donate_argnums=(0,),
+            **write_kwargs)
+
     def _host_swap_out(self, pairs):
         """BlockManager ``on_swap_out`` hook: copy each ``(bid, hid)``
         pair's device block into its host buffer.  The ``device_get``
         is the synchronization point — the payload lands on the host
         BEFORE ``swap_out``/``_evict_one`` returns the physical block to
         the free list, so a re-allocation can never race the copy."""
+        self._block_movers()
         tier = self.kv.host_tier
         for bid, hid in pairs:
             payload = jax.device_get(
@@ -1536,6 +1566,7 @@ class ServingEngine:
         The write fn donates the pool — same in-place aliasing contract
         as the step — and runs strictly between dispatches, so the
         once-jitted step never observes a swap as a new trace."""
+        self._block_movers()
         tier = self.kv.host_tier
         for hid, bid in pairs:
             payload = jax.tree_util.tree_map(jnp.asarray, tier.get(hid))
@@ -1757,6 +1788,149 @@ class ServingEngine:
     @property
     def preempt_decisions(self) -> List[Dict[str, object]]:
         return list(self._preempt_log)
+
+    # -- cross-worker migration (ISSUE 18) ---------------------------------
+
+    def export_request(self, rid: int,
+                       release: bool = True) -> Optional[Dict[str, object]]:
+        """Serialize an ACTIVELY DECODING request for migration to
+        another engine: the exact slot state a swap-resume would restore
+        (position, last token, decode budget, sampling knobs, SLO
+        deadlines, lifecycle uid) plus the request's KV chain by value
+        (``BlockManager.export_blocks`` with the jitted one-block reader
+        — scale rows travel with their payloads, so quantized blocks
+        migrate bit-for-bit).  Returns ``None`` when ``rid`` is not in a
+        decode slot (queued / mid-prefill / preempted requests are not
+        exportable — migrate them by resubmission instead).
+
+        ``release=True`` (the default) frees the slot and its blocks
+        after the copy — the request now lives wherever the record is
+        imported; partial output stays readable via ``result()``.  The
+        disaggregation flow is: prefill worker decodes the FIRST token,
+        exports, decode worker imports and finishes the request."""
+        if not self.paged:
+            raise RuntimeError(
+                "export_request requires the paged cache "
+                "(ServingEngine(..., paged=True))")
+        self._block_movers()
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.rid != rid:
+                continue
+            req = slot.req
+
+            def _read(bid: int):
+                return jax.device_get(
+                    self._read_block_fn(self._cache, jnp.int32(bid)))
+
+            blocks = self.kv.export_blocks(i, _read)
+            nbytes = sum(
+                int(a.nbytes) for e in blocks["entries"]
+                for a in jax.tree_util.tree_leaves(e["payload"]))
+            record = {
+                "uid": int(req.uid),
+                "prompt": [int(t) for t in req.prompt],
+                "generated": list(self._results.get(rid, [])),
+                "max_new_tokens": int(req.max_new_tokens),
+                "remaining": int(slot.remaining),
+                "position": int(self._positions[i]),
+                "last_token": int(self._tokens[i]),
+                "had_first": bool(slot.t_first > 0.0),
+                "sampling": {"temperature": float(req.sampling.temperature),
+                             "top_k": int(req.sampling.top_k),
+                             "top_p": float(req.sampling.top_p)},
+                "priority": int(req.priority),
+                "ttft_slo_ms": float(req.ttft_slo_ms),
+                "tpot_slo_ms": float(req.tpot_slo_ms),
+                "blocks": blocks,
+                "payload_bytes": int(nbytes),
+            }
+            self._m_mig_out.inc()
+            self._m_mig_bytes_out.inc(nbytes)
+            self._rlog.event(req.uid, "exported", engine=self._eid,
+                             slot=int(i),
+                             blocks=len(blocks["entries"]),
+                             bytes=int(nbytes))
+            self._tracer.instant("migration.export", rid=rid,
+                                 blocks=len(blocks["entries"]),
+                                 bytes=int(nbytes))
+            if release:
+                self._release(i)
+            return record
+        return None
+
+    def import_request(self, record: Dict[str, object]) -> Optional[int]:
+        """Materialise an exported request into a free slot of THIS
+        engine and continue its decode exactly where the exporter
+        stopped: blocks land via ``BlockManager.import_blocks`` + the
+        jitted one-block writer, host mirrors restore the swap-resume
+        way, and the prompt re-registers in the local prefix trie (dtype
+        tags preserved — mixed mode never re-demotes).  Returns the
+        LOCAL rid (the lifecycle uid in the record is adopted, so the
+        request keeps ONE timeline across workers), or ``None`` when no
+        free slot or pool room is available right now — the caller keeps
+        the record and retries, nothing is consumed."""
+        if not self.paged:
+            raise RuntimeError(
+                "import_request requires the paged cache "
+                "(ServingEngine(..., paged=True))")
+        self._block_movers()
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if self._prefill is not None:
+            free = [i for i in free if i != self._prefill.slot]
+        if not free:
+            return None
+        si = free[0]
+
+        def _write(bid: int, payload):
+            self._cache = self._write_block_fn(
+                self._cache,
+                jax.tree_util.tree_map(jnp.asarray, payload),
+                jnp.int32(bid))
+
+        got = self.kv.import_blocks(si, record["blocks"], _write)
+        if got is None:
+            return None
+        uid = int(record["uid"])
+        prompt = np.asarray(record["prompt"], np.int32)
+        sp = record["sampling"]
+        req = Request(
+            self._next_rid, prompt, int(record["max_new_tokens"]),
+            SamplingParams(temperature=float(sp["temperature"]),
+                           top_k=int(sp["top_k"]),
+                           top_p=float(sp["top_p"])),
+            t_submit=self._clock(), uid=uid,
+            ttft_slo_ms=float(record["ttft_slo_ms"]),
+            tpot_slo_ms=float(record["tpot_slo_ms"]),
+            priority=int(record["priority"]))
+        rid = self._next_rid
+        self._next_rid += 1
+        self._results[rid] = list(record["generated"])
+        self._uids[rid] = uid
+        # restore the slot the swap-resume way: mirrors, table row,
+        # decode budget; the TPOT clock restarts on this engine's clock
+        # (cross-process wall clocks don't compare — BASELINE.md
+        # "Multi-host accounting conventions")
+        self._slots[si] = _Slot(rid, int(record["remaining"]),
+                                t_first=(self._clock()
+                                         if record["had_first"] else 0.0),
+                                prompt=prompt, req=req)
+        self._active[si] = True
+        self._tokens[si] = int(record["last_token"])
+        self._positions[si] = int(record["position"])
+        self._temps[si] = req.sampling.temperature
+        self._topk[si] = req.sampling.top_k
+        self._topp[si] = req.sampling.top_p
+        self._tables[si] = self.kv.table_row(si, self.max_blocks)
+        self.kv.register_prompt_upto(si, prompt, int(prompt.size))
+        nbytes = int(record.get("payload_bytes", 0))
+        self._m_mig_in.inc()
+        self._m_mig_bytes_in.inc(nbytes)
+        self._rlog.event(uid, "imported", engine=self._eid,
+                         slot=int(si), blocks=int(got),
+                         bytes=nbytes)
+        self._tracer.instant("migration.import", rid=rid,
+                             blocks=int(got), bytes=nbytes)
+        return rid
 
     # -- cancellation (ISSUE 16 satellite) ---------------------------------
 

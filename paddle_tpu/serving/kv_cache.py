@@ -140,7 +140,8 @@ class _StatsView(Mapping):
     _KEYS = ("prefix_lookups", "prefix_hit_blocks", "prefix_hit_tokens",
              "evictions", "cow_copies", "peak_blocks_in_use",
              "quantized_blocks", "host_demotions", "host_promotions",
-             "swapped_out_blocks", "swapped_in_blocks")
+             "swapped_out_blocks", "swapped_in_blocks",
+             "exported_blocks", "imported_blocks")
 
     def __init__(self, mgr: "BlockManager"):
         self._mgr = mgr
@@ -371,6 +372,14 @@ class BlockManager:
                 "kv_cache.swapped_in_blocks",
                 "pinned host blocks restored to HBM by preemption "
                 "resume").labels(**lbl),
+            "exported_blocks": reg.counter(
+                "kv_cache.exported_blocks",
+                "blocks serialized out of this pool for cross-worker "
+                "migration (export_blocks)").labels(**lbl),
+            "imported_blocks": reg.counter(
+                "kv_cache.imported_blocks",
+                "blocks materialized into this pool from a migration "
+                "record (import_blocks)").labels(**lbl),
         }
         self._peak = 0
         self._g_peak = reg.gauge(
@@ -922,6 +931,65 @@ class BlockManager:
             else:
                 self._host.free(e[1])
         self._refresh_gauges()
+
+    # -- cross-pool migration (ISSUE 18) -----------------------------------
+
+    def export_blocks(self, slot: int, read_payload) -> Dict[str, object]:
+        """Serialize ``slot``'s chain for migration into ANOTHER pool:
+        one entry per block, in chain order, carrying the block's element
+        dtype tag and the payload ``read_payload(bid)`` returns (a host
+        pytree — the engine reads the device block including its scale
+        row, so quantized blocks survive the trip bit-for-bit).
+
+        By-value and read-only: shared (refcount > 1) blocks are copied
+        like private ones — the importing pool is a different manager,
+        so exporting never touches refcounts, the trie, or the LRU here.
+        The source chain stays fully live until the caller releases it.
+        """
+        st = self._slots[slot]
+        entries: List[Dict[str, object]] = [
+            {"dtype": self.block_dtype(bid), "payload": read_payload(
+                int(bid))} for bid in st.chain]
+        self._counters["exported_blocks"].inc(len(entries))
+        return {"entries": entries,
+                "reserved_left": int(st.reserved_left),
+                "block_len": int(self.block_len)}
+
+    def import_blocks(self, slot: int, record: Dict[str, object],
+                      write_payload) -> Optional[int]:
+        """Materialise an exported chain into (free) ``slot`` of THIS
+        pool: allocate one device block per entry, restore its dtype tag,
+        and hand the payload to ``write_payload(bid, payload)``; the
+        remaining admission reservation is re-armed so the imported
+        request can keep decoding to its original budget.  Returns the
+        chain length, or ``None`` when the pool cannot cover the blocks
+        plus the reservation right now (existing reservations are
+        respected — migration never strands an admitted local request).
+        Imported blocks are NOT marked fresh: their scale rows arrive in
+        the payload and must not be zeroed before the next dispatch."""
+        if slot in self._slots:
+            raise ValueError(f"slot {slot} already has an allocation")
+        if int(record.get("block_len", self.block_len)) != self.block_len:
+            raise ValueError(
+                f"block_len mismatch: record has "
+                f"{record.get('block_len')}, pool has {self.block_len}")
+        entries = record["entries"]
+        reserved = int(record["reserved_left"])
+        if self._available() < len(entries) + reserved:
+            return None
+        chain: List[int] = []
+        for e in entries:
+            bid = self._pop_block()
+            self._ref[bid] = 1
+            self._dtype[bid] = 1 if e["dtype"] == "int8" else 0
+            write_payload(int(bid), e["payload"])
+            chain.append(bid)
+        self._slots[slot] = _SlotAlloc(chain, reserved)
+        self._reserved += reserved
+        self._counters["imported_blocks"].inc(len(chain))
+        self._note_peak()
+        self._refresh_gauges()
+        return len(chain)
 
     def preempt_free(self, slot: int):
         """Recompute-mode preemption: pool mechanics identical to

@@ -367,6 +367,7 @@ def _smoke() -> int:
             summary[mode]["preempt_signature_stable"] = (
                 len(set(preempt_sigs)) <= 1)
     summary["fleet_sim"] = _smoke_fleet_sim(model, load, failures)
+    summary["multihost"] = _smoke_multihost(model, load, failures)
     summary["failures"] = failures
     print(json.dumps(summary, indent=2))
     return 1 if failures else 0
@@ -429,6 +430,125 @@ def _smoke_fleet_sim(model, load: Sequence[LoadRequest],
         failures.append("fleet_sim: fleet signature drift between "
                         "identical-seed replays")
     return dict(agree, fleet_signature_stable=len(set(sigs)) == 1)
+
+
+def _smoke_multihost(model, load: Sequence[LoadRequest],
+                     failures: List[str]) -> Dict[str, Any]:
+    """ISSUE 18 CI gates for the multi-host plane, run entirely over
+    LoopbackTransport (full RPC serialization, zero processes):
+
+    * the trace replayed twice through frontend-grade plumbing
+      (plane -> 2 engine workers) must keep the once-jitted budget
+      (step_traces <= 1), lint clean, and replay byte-stable
+      (timeline signature AND sampled outputs);
+
+    * a worker killed mid-trace must NOT hang or drop work: every
+      request still finishes, token-identical to the no-kill replay,
+      each under its ONE original lifecycle uid."""
+    from collections import OrderedDict
+
+    from .engine import ServingEngine
+    from .multihost import EngineWorker, LoopbackTransport, MultiHostRouter
+
+    # the modes above created ~a dozen engines; their per-engine counter
+    # children sit near the metrics_max_children cap, and a collapsed
+    # {overflow} child would MERGE step-trace counts across engines and
+    # fail the budget gate spuriously.  This leg builds everything
+    # fresh, so start it on a clean registry (replay brackets the
+    # request log with mark(), nothing above reads the registry later).
+    _obs.reset()
+
+    def mk_plane():
+        workers = OrderedDict()
+        engines = []
+        for i in range(2):
+            eng = ServingEngine(model, num_slots=4, max_length=128,
+                                prefill_batch=2, paged=True, block_len=8)
+            engines.append(eng)
+            w = EngineWorker(eng, name=f"w{i}")
+            workers[f"w{i}"] = LoopbackTransport(w.handle, name=f"w{i}")
+        return MultiHostRouter(workers, policy="prefix"), engines
+
+    runs = []
+    lint_findings = -1
+    for _ in range(2):
+        plane, engines = mk_plane()
+        if lint_findings < 0:
+            kf = [f for e in engines for f in e.lint_step()]
+            lint_findings = len(kf)
+            if kf:
+                failures.append("multihost: lint findings: "
+                                + "; ".join(str(f) for f in kf))
+        runs.append(replay(plane, load))
+    a, b = runs
+    traces = max(max(r["step_traces"]) for r in runs)
+    if traces > 1:
+        failures.append(f"multihost: step retraced (traces={traces})")
+    if a["signature"] != b["signature"]:
+        failures.append("multihost: timeline signature drift between "
+                        "identical-seed runs")
+    if a["outputs"] != b["outputs"]:
+        failures.append("multihost: sampled-output drift between "
+                        "identical-seed runs")
+
+    # -- worker-kill leg: same trace, one transport killed mid-flight
+    plane, _ = mk_plane()
+    order = sorted(range(len(load)),
+                   key=lambda i: (load[i].arrival, load[i].index))
+    rids: Dict[int, int] = {}
+    tick = 0
+    nxt = 0
+    killed = False
+    while nxt < len(order) or any(not r.done
+                                  for r in plane._reqs.values()):
+        while nxt < len(order) and load[order[nxt]].arrival <= tick:
+            r = load[order[nxt]]
+            rids[r.index] = plane.submit(
+                r.prompt, max_new_tokens=r.max_new_tokens)
+            nxt += 1
+        plane.step()
+        tick += 1
+        if not killed and tick >= 3:
+            victim = next((plane.worker_of(rid) for rid in rids.values()
+                           if plane.worker_of(rid) is not None), None)
+            if victim is not None:
+                plane._workers[victim].kill()
+                killed = True
+    if not killed:
+        failures.append("multihost: kill leg never found a placed "
+                        "request to orphan")
+    kill_outputs = [plane.result(rids[r.index])
+                    if r.index in rids else None for r in load]
+    finished_all = all(o is not None and len(o) > 0 for o in kill_outputs)
+    if not finished_all:
+        failures.append("multihost: killed worker left unfinished "
+                        "requests (failover hang)")
+    if kill_outputs != a["outputs"]:
+        failures.append("multihost: post-kill outputs drifted from the "
+                        "no-kill replay (recompute-from-prefix broke "
+                        "token identity)")
+    one_timeline = all(
+        _obs.get_request_log().event_names(
+            plane.request_uid(rid)).count("submitted") == 1
+        for rid in rids.values())
+    if not one_timeline:
+        failures.append("multihost: a failed-over request forked its "
+                        "lifecycle timeline (uid not threaded)")
+    return {
+        "ticks": a["ticks"],
+        "generated_tokens": a["generated_tokens"],
+        "step_traces": traces,
+        "lint_findings": lint_findings,
+        "deterministic": (a["signature"] == b["signature"]
+                          and a["outputs"] == b["outputs"]),
+        "kill": {"fired": killed,
+                 "lost_workers": len(plane.lost_workers),
+                 "failovers": int(
+                     plane.metrics()["aggregate"]["failovers"]),
+                 "finished_all": finished_all,
+                 "outputs_match_no_kill": kill_outputs == a["outputs"],
+                 "one_timeline_per_uid": one_timeline},
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

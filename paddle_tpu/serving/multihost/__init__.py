@@ -1,0 +1,32 @@
+"""Multi-host serving plane (ISSUE 18): process-separated router,
+engine workers behind an RPC surface, KV migration, and a streaming
+HTTP front end.
+
+Layering::
+
+    frontend.ServingFrontend        streaming /v1/generate, driver thread
+        plane.MultiHostRouter       placement / failover / disagg policy
+            transport.Transport     Loopback (in-process) or Socket (TCP)
+                worker.EngineWorker RPC verbs over ONE ServingEngine
+
+The SAME protocol runs in-process over :class:`LoopbackTransport`
+(every tier-1 test, the loadgen smoke, the fleet sim) and over real
+sockets between OS processes (``python -m paddle_tpu.serving.multihost
+--worker`` / ``--selfcheck``) — CI exercises the full wire path without
+ever spawning a process.
+"""
+
+from .transport import (IDEMPOTENT_METHODS, LoopbackTransport, RpcError,
+                        RpcServer, SocketTransport, StoreClient,
+                        StoreServer, Transport, TransportError,
+                        decode_message, encode_message, rendezvous)
+from .worker import EngineWorker
+from .plane import MultiHostRouter
+from .frontend import ServingFrontend
+
+__all__ = [
+    "IDEMPOTENT_METHODS", "LoopbackTransport", "RpcError", "RpcServer",
+    "SocketTransport", "StoreClient", "StoreServer", "Transport",
+    "TransportError", "decode_message", "encode_message", "rendezvous",
+    "EngineWorker", "MultiHostRouter", "ServingFrontend",
+]

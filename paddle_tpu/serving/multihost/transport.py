@@ -1,0 +1,508 @@
+"""Wire layer of the multi-host serving plane (ISSUE 18).
+
+One protocol, two carriers:
+
+  * :class:`SocketTransport` / :class:`RpcServer` — length-prefixed JSON
+    frames over TCP (4-byte big-endian length, then a UTF-8 JSON body;
+    numpy arrays and raw bytes ride inline as tagged base64 objects, so
+    paged KV-block payloads cross the wire without any non-stdlib
+    dependency).  Calls carry per-call timeouts; connect failures retry
+    with deterministic exponential backoff, and IDEMPOTENT methods
+    (ping/status/result/...) additionally retry a broken call once the
+    connection re-establishes.
+
+  * :class:`LoopbackTransport` — the SAME interface in-process: every
+    call still round-trips through ``encode_message``/``decode_message``
+    (both directions), so CI, the fleet simulator, and tier-1 tests
+    exercise the full serialization protocol without sockets or
+    processes, deterministically.  ``kill()`` simulates worker loss —
+    subsequent calls raise :class:`TransportError` exactly like a dead
+    TCP peer.
+
+Worker rendezvous is TCP-store style: :class:`StoreServer` is a tiny
+key/value service (set / get / wait) served over the same RPC framing;
+workers publish ``worker/<name> -> host:port`` and the plane's
+:func:`rendezvous` blocks until all expected workers have registered.
+
+Telemetry: every call increments ``rpc.calls`` / ``rpc.errors`` /
+``rpc.retries`` and the byte counters, and opens an ``rpc.call``
+Perfetto span — label cardinality is bounded by transport name, not
+method.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ... import flags as _flags
+from ... import observability as _obs
+
+__all__ = [
+    "encode_message", "decode_message", "RpcError", "TransportError",
+    "Transport", "LoopbackTransport", "SocketTransport", "RpcServer",
+    "StoreServer", "StoreClient", "rendezvous",
+]
+
+# calls safe to replay blind after a reconnect (read-only or naturally
+# idempotent); everything else fails fast to the caller's failover path
+IDEMPOTENT_METHODS = frozenset({
+    "ping", "status", "result", "request_uid", "metrics", "prefix_probe",
+    "lint", "store.get", "store.set", "store.wait"})
+
+_HDR = struct.Struct(">I")
+_MAX_FRAME = 1 << 30
+
+
+# -- message codec -----------------------------------------------------------
+
+def _enc(obj: Any) -> Any:
+    if isinstance(obj, np.ndarray):
+        return {"__nd__": {"dtype": obj.dtype.name,
+                           "shape": list(obj.shape),
+                           "data": base64.b64encode(
+                               np.ascontiguousarray(obj).tobytes()
+                           ).decode("ascii")}}
+    if isinstance(obj, (bytes, bytearray)):
+        return {"__bytes__": base64.b64encode(bytes(obj)).decode("ascii")}
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {str(k): _enc(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_enc(v) for v in obj]
+    return obj
+
+
+def _dec(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if "__nd__" in obj and len(obj) == 1:
+            nd = obj["__nd__"]
+            raw = base64.b64decode(nd["data"])
+            return np.frombuffer(raw, dtype=np.dtype(nd["dtype"])).reshape(
+                nd["shape"]).copy()
+        if "__bytes__" in obj and len(obj) == 1:
+            return base64.b64decode(obj["__bytes__"])
+        return {k: _dec(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_dec(v) for v in obj]
+    return obj
+
+
+def encode_message(obj: Any) -> bytes:
+    """Serialize a payload tree (JSON scalars, lists, str-keyed dicts,
+    numpy arrays, bytes) into one wire frame body.  Dict keys are
+    coerced to ``str`` — the protocol convention is string keys
+    everywhere (worker responses key deltas by ``str(rid)``)."""
+    return json.dumps(_enc(obj), separators=(",", ":"),
+                      allow_nan=False).encode("utf-8")
+
+
+def decode_message(body: bytes) -> Any:
+    return _dec(json.loads(body.decode("utf-8")))
+
+
+def write_frame(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(_HDR.pack(len(body)) + body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> bytes:
+    (n,) = _HDR.unpack(_read_exact(sock, _HDR.size))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"frame length {n} exceeds cap {_MAX_FRAME}")
+    return _read_exact(sock, n)
+
+
+# -- errors ------------------------------------------------------------------
+
+class RpcError(Exception):
+    """The remote handler raised: the call REACHED the worker and failed
+    there (``kind`` is the remote exception type — the plane's admission
+    failover keys on ``kind == 'ValueError'``, the engine's rejection
+    contract).  The worker itself is alive."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+        self.message = message
+
+
+class TransportError(Exception):
+    """The call did NOT complete: connection refused/reset, timeout, or
+    a killed loopback peer.  The caller must treat the worker as lost
+    (heartbeat/failover territory) — whether the side effect happened is
+    unknowable from here."""
+
+
+# -- metrics -----------------------------------------------------------------
+
+class _RpcMetrics:
+    def __init__(self, name: str):
+        reg = _obs.default_registry()
+        lbl = {"transport": name}
+        self.calls = reg.counter(
+            "rpc.calls", "RPC calls issued").labels(**lbl)
+        self.errors = reg.counter(
+            "rpc.errors",
+            "RPC calls that failed (remote fault or transport "
+            "loss)").labels(**lbl)
+        self.retries = reg.counter(
+            "rpc.retries",
+            "reconnect/backoff retries across all calls").labels(**lbl)
+        self.bytes_sent = reg.counter(
+            "rpc.bytes_sent", "request frame bytes").labels(**lbl)
+        self.bytes_recv = reg.counter(
+            "rpc.bytes_recv", "response frame bytes").labels(**lbl)
+        self.call_ms = reg.histogram(
+            "rpc.call_ms", "round-trip wall time per call").labels(**lbl)
+
+
+# -- transports --------------------------------------------------------------
+
+class Transport:
+    """The one client surface both carriers implement."""
+
+    name = "?"
+    # True when client and worker share one process (and therefore one
+    # RequestLog): the plane skips merging shipped worker events then,
+    # since the worker already wrote them into the shared log
+    shares_process = False
+
+    def call(self, method: str, payload: Optional[Dict[str, Any]] = None,
+             timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def alive(self) -> bool:
+        return True
+
+
+class LoopbackTransport(Transport):
+    """In-process carrier: ``handler(method, payload) -> result`` with
+    the full encode/decode round trip on BOTH legs, so whatever the
+    socket path would serialize, this path serializes too.  Worker loss
+    is scripted — ``kill()`` makes every later call raise
+    :class:`TransportError`, which is exactly what a dead TCP peer looks
+    like to the plane."""
+
+    shares_process = True
+
+    def __init__(self, handler: Callable[[str, Dict[str, Any]], Any],
+                 name: str = "loopback"):
+        self._handler = handler
+        self.name = name
+        self._dead = False
+        self._m = _RpcMetrics(name)
+        self._tracer = _obs.get_tracer()
+
+    def kill(self) -> None:
+        """Simulate worker loss from now on (deterministic)."""
+        self._dead = True
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead
+
+    def call(self, method: str, payload: Optional[Dict[str, Any]] = None,
+             timeout: Optional[float] = None) -> Any:
+        self._m.calls.inc()
+        t0 = time.perf_counter()
+        with self._tracer.span("rpc.call", transport=self.name,
+                               method=method):
+            if self._dead:
+                self._m.errors.inc()
+                raise TransportError(f"{self.name}: worker is gone")
+            req = encode_message({"method": method,
+                                  "payload": payload or {}})
+            self._m.bytes_sent.inc(len(req))
+            frame = decode_message(req)
+            try:
+                result = self._handler(frame["method"], frame["payload"])
+                resp = encode_message({"ok": True, "result": result})
+            except Exception as e:                      # noqa: BLE001
+                resp = encode_message({"ok": False,
+                                       "error": {"kind": type(e).__name__,
+                                                 "msg": str(e)}})
+            self._m.bytes_recv.inc(len(resp))
+            out = decode_message(resp)
+        self._m.call_ms.observe((time.perf_counter() - t0) * 1e3)
+        if not out["ok"]:
+            self._m.errors.inc()
+            raise RpcError(out["error"]["kind"], out["error"]["msg"])
+        return out["result"]
+
+
+class SocketTransport(Transport):
+    """TCP carrier with per-call timeouts, deterministic exponential
+    backoff on (re)connect, and blind retry only for IDEMPOTENT
+    methods.  One in-flight call at a time per transport (the plane is
+    a single-threaded scheduler; the frontend talks to the plane, not
+    to workers)."""
+
+    def __init__(self, host: str, port: int, name: Optional[str] = None,
+                 timeout: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff: Optional[float] = None):
+        self.host = host
+        self.port = int(port)
+        self.name = name or f"{host}:{port}"
+        self._timeout = float(timeout if timeout is not None
+                              else _flags.flag("multihost_call_timeout_s"))
+        self._retries = int(retries if retries is not None
+                            else _flags.flag("multihost_call_retries"))
+        self._backoff = float(backoff if backoff is not None
+                              else _flags.flag("multihost_retry_backoff_s"))
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._dead = False
+        self._m = _RpcMetrics(self.name)
+        self._tracer = _obs.get_tracer()
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead
+
+    def _connect(self, timeout: float) -> socket.socket:
+        last: Optional[Exception] = None
+        for attempt in range(self._retries + 1):
+            if attempt:
+                self._m.retries.inc()
+                time.sleep(self._backoff * (2 ** (attempt - 1)))
+            try:
+                s = socket.create_connection((self.host, self.port),
+                                             timeout=timeout)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except OSError as e:
+                last = e
+        raise TransportError(
+            f"{self.name}: connect failed after "
+            f"{self._retries + 1} attempts: {last}")
+
+    def _roundtrip(self, req: bytes, timeout: float) -> bytes:
+        if self._sock is None:
+            self._sock = self._connect(timeout)
+        self._sock.settimeout(timeout)
+        write_frame(self._sock, req)
+        return read_frame(self._sock)
+
+    def call(self, method: str, payload: Optional[Dict[str, Any]] = None,
+             timeout: Optional[float] = None) -> Any:
+        if self._dead:
+            self._m.errors.inc()
+            raise TransportError(f"{self.name}: transport closed")
+        tmo = float(timeout if timeout is not None else self._timeout)
+        req = encode_message({"method": method, "payload": payload or {}})
+        self._m.calls.inc()
+        self._m.bytes_sent.inc(len(req))
+        t0 = time.perf_counter()
+        with self._lock, self._tracer.span(
+                "rpc.call", transport=self.name, method=method):
+            attempts = (self._retries + 1
+                        if method in IDEMPOTENT_METHODS else 1)
+            last: Optional[Exception] = None
+            resp = None
+            for attempt in range(attempts):
+                if attempt:
+                    self._m.retries.inc()
+                    time.sleep(self._backoff * (2 ** (attempt - 1)))
+                try:
+                    resp = self._roundtrip(req, tmo)
+                    break
+                except (OSError, ConnectionError) as e:
+                    last = e
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+            if resp is None:
+                self._m.errors.inc()
+                raise TransportError(f"{self.name}: {method} failed: {last}")
+        self._m.bytes_recv.inc(len(resp))
+        self._m.call_ms.observe((time.perf_counter() - t0) * 1e3)
+        out = decode_message(resp)
+        if not out["ok"]:
+            self._m.errors.inc()
+            raise RpcError(out["error"]["kind"], out["error"]["msg"])
+        return out["result"]
+
+    def close(self) -> None:
+        self._dead = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+# -- server ------------------------------------------------------------------
+
+class RpcServer:
+    """Threaded frame server: one accept loop, one thread per
+    connection, ``handler(method, payload) -> result`` dispatched per
+    frame.  Handler exceptions become structured error responses (the
+    connection survives); transport-level breakage just drops that
+    connection."""
+
+    def __init__(self, handler: Callable[[str, Dict[str, Any]], Any],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(port)))
+        self._lsock.listen(16)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        name=f"rpc-accept:{self.port}",
+                                        daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self) -> None:
+        self._lsock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    frame = decode_message(read_frame(conn))
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    result = self._handler(frame["method"],
+                                           frame.get("payload") or {})
+                    resp = {"ok": True, "result": result}
+                except Exception as e:                  # noqa: BLE001
+                    resp = {"ok": False,
+                            "error": {"kind": type(e).__name__,
+                                      "msg": str(e)}}
+                try:
+                    write_frame(conn, encode_message(resp))
+                except (ConnectionError, OSError):
+                    return
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "RpcServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+# -- TCP-store rendezvous ----------------------------------------------------
+
+class StoreServer:
+    """TCP-store-style rendezvous: a key/value dict behind the RPC
+    framing with a blocking ``wait`` — workers ``set`` their address
+    under ``worker/<name>``, the plane ``wait``s for the full roster."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._kv: Dict[str, Any] = {}
+        self._cond = threading.Condition()
+        self._rpc = RpcServer(self._handle, host=host, port=port)
+        self.host, self.port = self._rpc.host, self._rpc.port
+
+    def _handle(self, method: str, payload: Dict[str, Any]) -> Any:
+        if method == "store.set":
+            with self._cond:
+                self._kv[str(payload["key"])] = payload["value"]
+                self._cond.notify_all()
+            return {"ok": 1}
+        if method == "store.get":
+            with self._cond:
+                return {"value": self._kv.get(str(payload["key"]))}
+        if method == "store.wait":
+            keys = [str(k) for k in payload["keys"]]
+            deadline = time.monotonic() + float(payload.get("timeout", 30.0))
+            with self._cond:
+                while not all(k in self._kv for k in keys):
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cond.wait(timeout=left):
+                        missing = [k for k in keys if k not in self._kv]
+                        raise TimeoutError(
+                            f"rendezvous timed out waiting for {missing}")
+                return {"values": {k: self._kv[k] for k in keys}}
+        raise ValueError(f"unknown store method {method!r}")
+
+    def stop(self) -> None:
+        self._rpc.stop()
+
+    def __enter__(self) -> "StoreServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+class StoreClient:
+    """Client half of the rendezvous store (workers + plane)."""
+
+    def __init__(self, host: str, port: int,
+                 timeout: Optional[float] = None):
+        self._t = SocketTransport(host, port, name=f"store:{host}:{port}",
+                                  timeout=timeout)
+
+    def set(self, key: str, value: Any) -> None:
+        self._t.call("store.set", {"key": key, "value": value})
+
+    def get(self, key: str) -> Any:
+        return self._t.call("store.get", {"key": key})["value"]
+
+    def wait(self, keys: List[str], timeout: float = 30.0) -> Dict[str, Any]:
+        return self._t.call("store.wait",
+                            {"keys": list(keys), "timeout": timeout},
+                            timeout=timeout + 5.0)["values"]
+
+    def close(self) -> None:
+        self._t.close()
+
+
+def rendezvous(store: StoreClient, names: List[str],
+               timeout: float = 30.0) -> Dict[str, Tuple[str, int]]:
+    """Block until every worker in ``names`` has published its RPC
+    address under ``worker/<name>``; returns name -> (host, port)."""
+    vals = store.wait([f"worker/{n}" for n in names], timeout=timeout)
+    return {n: (vals[f"worker/{n}"]["host"],
+                int(vals[f"worker/{n}"]["port"])) for n in names}

@@ -347,6 +347,7 @@ def test_ddim_sample_denoises_a_trained_target():
     assert err_after < err_before * 0.6, (err_before, err_after)
 
 
+@pytest.mark.slow
 def test_ernie_moe_packed_sequences_match_per_document():
     """Packing composes with the MoE decoder: packed row == per-document
     forwards, and boundary labels are dropped from the loss."""

@@ -802,6 +802,7 @@ def test_generate_proposals_clips_and_caps():
         ofs += k
 
 
+@pytest.mark.slow
 def test_yolo_loss_target_sensitivity():
     anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
                59, 119, 116, 90, 156, 198, 373, 326]
@@ -858,6 +859,7 @@ def test_sparse_attention_matches_masked_dense():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sparse_conv3d_against_dense_torch():
     import paddle_tpu.sparse.nn as spnn
     from jax.experimental import sparse as jsparse

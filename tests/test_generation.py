@@ -140,6 +140,7 @@ def test_decode_step_export_roundtrip(lm, tmp_path):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ernie_moe_greedy_generate_matches_full_forward():
     """The MoE decoder shares the cache machinery; same gold-standard
     property (capacity is recomputed per decode shape, so routing at
@@ -172,7 +173,9 @@ def test_generate_rejects_past_rope_cache(lm):
         lm.generate(_prompt(1, 120), max_new_tokens=20)
 
 
-@pytest.mark.parametrize("family", ["mamba", "rwkv"])
+@pytest.mark.parametrize(
+    "family",
+    [pytest.param("mamba", marks=pytest.mark.slow), "rwkv"])
 def test_recurrent_decode_matches_full_forward(family):
     """Mamba-2 / RWKV carry O(1) recurrence state instead of a KV cache;
     the same gold-standard property must hold: greedy cached decode ==
@@ -395,7 +398,9 @@ def _np_beam_search(full_forward, ids, n_new, k, eos=None, pad=0, lp=1.0):
     return np.asarray(outs, np.int32)
 
 
-@pytest.mark.parametrize("eos", [None, 5])
+@pytest.mark.parametrize(
+    "eos",
+    [pytest.param(None, marks=pytest.mark.slow), 5])
 def test_beam_search_matches_numpy_reference(lm, eos):
     ids = _prompt(2, 5, seed=43)
     n_new, k = 6, 4
@@ -406,6 +411,7 @@ def test_beam_search_matches_numpy_reference(lm, eos):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_beam_search_recurrent_family_matches_numpy_reference():
     from paddle_tpu.models.rwkv import RwkvForCausalLM, tiny_rwkv_config
 

@@ -241,3 +241,135 @@ def test_peak_counter_and_needed():
     m.release(0)
     m.release(1)
     assert m.stats["peak_blocks_in_use"] == 4
+
+
+# -- cross-pool migration: export_blocks / import_blocks (ISSUE 18) -------
+#
+# The engine reads each device block (payload + scale row) through
+# read_payload and writes it back through write_payload; here the
+# payloads are opaque host values, so the tests pin the MANAGER's side
+# of the contract: chain order, dtype tags, refcount safety, and the
+# importer's reservation math.
+
+def _payloads(m, slot, tag="src"):
+    """A fake device read: one distinct payload per chain block."""
+    return {bid: (tag, int(bid)) for bid in m.chain(slot)}
+
+
+def test_export_import_roundtrip_bf16():
+    src = _mgr(num_blocks=9, block_len=4)
+    p = _toks(6, 20)
+    src.admit(0, p, 6, 10)                       # reserves ceil(16/4) = 4
+    src.ensure_capacity(0, 8)                    # grow to 3 blocks live
+    store = _payloads(src, 0)
+    rec = src.export_blocks(0, lambda bid: store[bid])
+    # by-value snapshot in chain order, dtype-tagged, reservation carried
+    assert [e["payload"] for e in rec["entries"]] == \
+        [store[b] for b in src.chain(0)]
+    assert [e["dtype"] for e in rec["entries"]] == ["bf16"] * 3
+    assert rec["reserved_left"] == 1 and rec["block_len"] == 4
+    assert src.stats["exported_blocks"] == 3
+    # source chain stays fully live until the caller releases it
+    assert src.blocks_in_use() == 3
+
+    dst = _mgr(num_blocks=9, block_len=4)
+    writes = []
+    n = dst.import_blocks(0, rec, lambda bid, pay: writes.append(
+        (int(bid), pay)))
+    assert n == 3 and dst.stats["imported_blocks"] == 3
+    # payloads land on the allocated chain in exporter order, bit-for-bit
+    assert [b for b, _ in writes] == dst.chain(0)
+    assert [pay for _, pay in writes] == \
+        [e["payload"] for e in rec["entries"]]
+    # imported blocks are NOT fresh: their scale rows arrived in the
+    # payload and must not be zeroed before the next dispatch
+    assert not (set(dst.chain(0)) & dst._fresh)
+    # the remaining reservation is re-armed: one more block, then the
+    # original admission ceiling holds exactly
+    assert dst.ensure_capacity(0, 12) is True
+    with pytest.raises(RuntimeError, match="reservation"):
+        dst.ensure_capacity(0, 16)
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "mixed"])
+def test_export_import_preserves_dtype_tags_and_scales(kv_dtype):
+    src = BlockManager(9, 4, kv_dtype=kv_dtype)
+    p = _toks(10, 21)                            # 2 full blocks + tail
+    src.admit(0, p, 10, 6)
+    tags = [src.block_dtype(b) for b in src.chain(0)]
+    if kv_dtype == "mixed":
+        # registered full prefix blocks demote to int8; the mutable
+        # tail block stays bf16 — the record must carry the mix
+        assert tags == ["int8", "int8", "bf16"]
+    else:
+        assert tags == ["int8"] * 3
+    # the "scale row" rides inside the payload, like the engine's
+    # device read of a quantized block
+    store = {bid: {"body": ("blk", int(bid)),
+                   "scale": ("scale", int(bid))}
+             for bid in src.chain(0)}
+    rec = src.export_blocks(0, lambda bid: store[bid])
+    assert [e["dtype"] for e in rec["entries"]] == tags
+
+    dst = BlockManager(9, 4, kv_dtype=kv_dtype)
+    got = {}
+    n = dst.import_blocks(0, rec, lambda bid, pay: got.__setitem__(
+        int(bid), pay))
+    assert n == 3
+    # per-block dtype tags restored on the importing pool's ids, and
+    # the scale payloads arrive untouched
+    assert [dst.block_dtype(b) for b in dst.chain(0)] == tags
+    assert [got[b] for b in dst.chain(0)] == \
+        [e["payload"] for e in rec["entries"]]
+
+
+def test_export_shared_block_copies_by_value_refcounts_untouched():
+    src = _mgr(num_blocks=17, block_len=4)
+    p = _toks(8, 22)
+    src.admit(0, p, 8, 4)                        # registers both blocks
+    src.admit(1, p + _toks(3, 23, lo=200, hi=300), 11, 4)
+    shared = src.chain(0)[:2]
+    assert src.chain(1)[:2] == shared            # refcount 2 on both
+    store = _payloads(src, 1)
+    rec = src.export_blocks(1, lambda bid: store[bid])
+    assert len(rec["entries"]) == len(src.chain(1))
+    # export is read-only: both chains still share, the owner still
+    # COWs, and releasing the exported slot derefs exactly once
+    assert src.chain(0)[:2] == shared == src.chain(1)[:2]
+    assert src.ensure_writable(1, 0) is not None  # still shared -> COW
+    src.release(1)
+    assert src.chain(0)[:2] == shared            # owner untouched
+    src.release(0)
+    assert src.blocks_in_use() == 0              # no refcount leak
+
+
+def test_import_respects_existing_reservations():
+    rec_src = _mgr(num_blocks=9, block_len=4)
+    rec_src.admit(0, _toks(6, 24), 6, 10)        # 2 blocks + 2 reserved
+    store = _payloads(rec_src, 0)
+    rec = rec_src.export_blocks(0, lambda bid: store[bid])
+
+    dst = _mgr(num_blocks=6, block_len=4)        # 5 usable blocks
+    dst.admit(0, _toks(6, 25, lo=200, hi=300), 6, 6)  # reserves 3
+    # available = 5 - 3 = 2 < entries(2) + reserved(2): the local
+    # admission's reservation is respected — migration never strands
+    # an already-admitted request
+    assert dst.import_blocks(1, rec, lambda bid, pay: None) is None
+    assert dst.blocks_in_use() == 2              # nothing half-imported
+    dst.release(0)
+    assert dst.import_blocks(1, rec, lambda bid, pay: None) == 2
+
+
+def test_import_rejects_occupied_slot_and_block_len_mismatch():
+    src = _mgr(num_blocks=9, block_len=4)
+    src.admit(0, _toks(6, 26), 6, 4)
+    store = _payloads(src, 0)
+    rec = src.export_blocks(0, lambda bid: store[bid])
+
+    dst = _mgr(num_blocks=9, block_len=4)
+    dst.admit(0, _toks(6, 27, lo=200, hi=300), 6, 4)
+    with pytest.raises(ValueError, match="already has"):
+        dst.import_blocks(0, rec, lambda bid, pay: None)
+    dst8 = _mgr(num_blocks=9, block_len=8)
+    with pytest.raises(ValueError, match="block_len"):
+        dst8.import_blocks(1, rec, lambda bid, pay: None)

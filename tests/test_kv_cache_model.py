@@ -110,6 +110,13 @@ class RefPool:
         self.host_promotions = 0
         self.swapped_out = 0
         self.swapped_in = 0
+        # cross-pool migration (ISSUE 18): export is BY VALUE (no pins,
+        # no refcount or trie coupling), so the model record is just the
+        # dtype tags + the remembered reservation
+        self.mig_record = None             # model-side record
+        self.real_mig = None               # the implementation's record
+        self.exported = 0
+        self.imported = 0
 
     # -- helpers ----------------------------------------------------------
 
@@ -340,6 +347,33 @@ class RefPool:
         self.swap_record = {"entries": entries, "left": st["left"]}
         return self.swap_record
 
+    def export_blocks(self, slot):
+        # read-only by-value snapshot: shared blocks copy like private
+        # ones; nothing in the pool changes until the caller releases
+        st = self.slots[slot]
+        self.mig_record = {"dtypes": [e.dtype for e in st["chain"]],
+                           "left": st["left"]}
+        self.exported += len(st["chain"])
+        return (tuple(self.mig_record["dtypes"]),
+                int(self.mig_record["left"]))
+
+    def import_blocks(self, slot):
+        rec = self.mig_record
+        n = len(rec["dtypes"])
+        if self.available() < n + rec["left"]:
+            return None
+        chain = []
+        for dt in rec["dtypes"]:
+            e = self._pop_block()
+            e.refs = 1
+            e.dtype = dt               # tag restored; NOT registered
+            chain.append(e)
+        self.slots[slot] = {"chain": chain, "left": rec["left"]}
+        self.reserved += rec["left"]
+        self.imported += n
+        self.mig_record = None
+        return n
+
     def swap_in(self, slot):
         rec = self.swap_record
         entries = rec["entries"]
@@ -454,6 +488,38 @@ def _op_preempt_free(mgr, model):
     return (mgr.preempt_free(0), model.release(0))
 
 
+def _op_export(mgr, model):
+    """The engine's export_request sequence at pool scope: by-value
+    snapshot of slot 0's chain, then release of the source slot (the
+    request now lives wherever the record lands)."""
+    chain = list(mgr._slots[0].chain)
+    rec = mgr.export_blocks(0, lambda bid: ("pay", int(bid)))
+    # payloads are read per chain block, in order, by value
+    assert ([e["payload"] for e in rec["entries"]]
+            == [("pay", b) for b in chain])
+    real = (tuple(e["dtype"] for e in rec["entries"]),
+            int(rec["reserved_left"]))
+    ref = model.export_blocks(0)
+    mgr.release(0)
+    model.release(0)
+    model.real_mig = rec
+    return real, ref
+
+
+def _op_import(mgr, model):
+    writes = []
+    n = mgr.import_blocks(0, model.real_mig,
+                          lambda bid, pay: writes.append((int(bid), pay)))
+    ref = model.import_blocks(0)
+    if n is not None:
+        # payloads delivered onto the allocated chain in exporter order
+        assert [b for b, _ in writes] == mgr.chain(0)
+        assert ([pay for _, pay in writes]
+                == [e["payload"] for e in model.real_mig["entries"]])
+        model.real_mig = None
+    return n, ref
+
+
 def _cow_enabled(m):
     if 1 not in m.slots or not m.slots[1]["chain"]:
         return False
@@ -487,6 +553,16 @@ OPS = [
      _op_swap_in),
     ("preempt_free",
      lambda m: m.host_cap > 0 and 0 in m.slots, _op_preempt_free),
+    # cross-pool migration ops (ISSUE 18): export+release of slot 0's
+    # chain, and re-materialisation into the (freed) slot — importing
+    # into the SAME pool is pool-mechanically identical to a decode
+    # worker's import and lets the record interleave with eviction,
+    # COW, swap and admission pressure
+    ("export",
+     lambda m: 0 in m.slots and m.mig_record is None, _op_export),
+    ("import",
+     lambda m: m.mig_record is not None and 0 not in m.slots,
+     _op_import),
 ]
 _OP_BY_NAME = {name: (name, en, ap) for name, en, ap in OPS}
 
@@ -591,6 +667,8 @@ def _check(mgr, model, trace):
     assert mgr.stats["host_promotions"] == model.host_promotions, ctx
     assert mgr.stats["swapped_out_blocks"] == model.swapped_out, ctx
     assert mgr.stats["swapped_in_blocks"] == model.swapped_in, ctx
+    assert mgr.stats["exported_blocks"] == model.exported, ctx
+    assert mgr.stats["imported_blocks"] == model.imported, ctx
 
 
 def _replay(ops, kv_dtype, check_every=True, host_blocks=0):
@@ -614,8 +692,10 @@ def _replay(ops, kv_dtype, check_every=True, host_blocks=0):
     return mgr, model
 
 
-@pytest.mark.parametrize("host_blocks", [0, HOST_BLOCKS],
-                         ids=["flat", "tiered"])
+@pytest.mark.parametrize(
+    "host_blocks",
+    [0, pytest.param(HOST_BLOCKS, marks=pytest.mark.slow)],
+    ids=["flat", "tiered"])
 @pytest.mark.parametrize("kv_dtype", ["bf16", "mixed", "int8"])
 def test_exhaustive_interleavings(kv_dtype, host_blocks, monkeypatch):
     """All enabled-op interleavings to depth 6, invariants after every

@@ -1,0 +1,332 @@
+"""Multi-host serving plane (paddle_tpu/serving/multihost/, ISSUE 18).
+
+Three layers, cheapest first:
+
+  * transport — codec/framing round-trips, RpcServer + SocketTransport
+    over real localhost sockets (remote raises -> RpcError with the
+    remote kind, dead peer -> TransportError), and the TCP-store
+    rendezvous (no engines involved);
+  * plane over LoopbackTransport — the full wire protocol (encode +
+    decode both legs) against real tiny engines: placement parity with
+    a single-engine reference, worker-kill failover that keeps ONE
+    request_uid timeline, and disaggregated prefill/decode migration
+    with token-identical outputs;
+  * streaming front end — a real HTTP server: /v1/generate must put
+    the first token chunk on the wire BEFORE the request retires
+    (streaming TTFT is first-chunk-on-wire — BASELINE.md 'Multi-host
+    accounting conventions'), plus the /requests?uid= single-timeline
+    lookup and the bounded ?limit= tail.
+
+The cross-process carrier (real worker subprocesses + rendezvous +
+induced crash) is exercised by ``python -m paddle_tpu.serving.multihost
+--selfcheck`` in the verify recipe — the protocol is identical here by
+construction (LoopbackTransport round-trips the same frames).
+"""
+
+import http.client
+import json
+import socket
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.multihost import (
+    EngineWorker, LoopbackTransport, MultiHostRouter, RpcError, RpcServer,
+    ServingFrontend, SocketTransport, StoreClient, StoreServer,
+    decode_message, encode_message, rendezvous)
+from paddle_tpu.serving.multihost.transport import read_frame, write_frame
+
+from collections import OrderedDict
+
+
+# -- transport: codec + framing (no sockets, no engines) ------------------
+
+def test_codec_roundtrip_arrays_bytes_nested():
+    msg = {"a": np.arange(12, dtype=np.int32).reshape(3, 4),
+           "b": np.float32(1.5), "c": b"\x00\xffraw",
+           "d": {"nested": [1, "two", None, True,
+                            np.array([0.25, -2.0], np.float64)]},
+           5: "int-key"}
+    out = decode_message(encode_message(msg))
+    np.testing.assert_array_equal(out["a"], msg["a"])
+    assert out["a"].dtype == np.int32 and out["a"].shape == (3, 4)
+    assert out["b"] == 1.5 and out["c"] == b"\x00\xffraw"
+    assert out["d"]["nested"][:4] == [1, "two", None, True]
+    np.testing.assert_array_equal(out["d"]["nested"][4],
+                                  np.array([0.25, -2.0]))
+    # dict keys are coerced to str: the protocol convention everywhere
+    assert out["5"] == "int-key"
+
+
+def test_framing_length_prefix_roundtrip_and_cap():
+    a, b = socket.socketpair()
+    try:
+        body = encode_message({"x": np.arange(5)})
+        write_frame(a, body)
+        write_frame(a, b"")                       # empty frame is legal
+        assert read_frame(b) == body
+        assert read_frame(b) == b""
+        # a corrupt length prefix past the cap fails loudly, not with
+        # a gigabyte allocation
+        a.sendall(b"\xff\xff\xff\xff")
+        with pytest.raises(ConnectionError, match="exceeds cap"):
+            read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- transport: RPC + rendezvous over real localhost sockets --------------
+
+def test_rpc_server_roundtrip_remote_error_and_dead_peer():
+    calls = []
+
+    def handler(method, payload):
+        calls.append(method)
+        if method == "boom":
+            raise ValueError("rejected: no slot")
+        return {"echo": payload}
+
+    srv = RpcServer(handler, port=0)
+    t = SocketTransport(srv.host, srv.port, name="t0", timeout=5.0,
+                        retries=1, backoff=0.01)
+    try:
+        out = t.call("ping", {"arr": np.arange(3, dtype=np.int32)})
+        np.testing.assert_array_equal(out["echo"]["arr"], np.arange(3))
+        # remote raise -> RpcError carrying the REMOTE kind: the
+        # plane's admission-failover path keys on kind == "ValueError"
+        with pytest.raises(RpcError) as ei:
+            t.call("boom", {})
+        assert ei.value.kind == "ValueError"
+        assert "no slot" in str(ei.value)
+    finally:
+        srv.stop()
+        t.close()
+    # a dead peer is a TRANSPORT error (the worker-loss signal the
+    # plane keys failover on), never an RpcError
+    from paddle_tpu.serving.multihost import TransportError
+    t2 = SocketTransport(srv.host, srv.port, name="t1", timeout=1.0,
+                         retries=0, backoff=0.01)
+    with pytest.raises(TransportError):
+        t2.call("ping", {})
+    t2.close()
+    assert calls == ["ping", "boom"]
+
+
+def test_store_rendezvous_wait_and_timeout():
+    with StoreServer() as store:
+        c1 = StoreClient(store.host, store.port)
+        c2 = StoreClient(store.host, store.port)
+        try:
+            c1.set("worker/w0", {"host": "127.0.0.1", "port": 1111})
+            assert c2.get("worker/w0")["port"] == 1111
+            assert c2.get("worker/missing") is None
+            c2.set("worker/w1", {"host": "127.0.0.1", "port": 2222})
+            addrs = rendezvous(c1, ["w0", "w1"], timeout=5.0)
+            assert addrs == {"w0": ("127.0.0.1", 1111),
+                             "w1": ("127.0.0.1", 2222)}
+            # a missing member times out on the SERVER and surfaces as
+            # a remote TimeoutError, not a hung client
+            with pytest.raises(RpcError) as ei:
+                c1.wait(["worker/w2"], timeout=0.2)
+            assert ei.value.kind == "TimeoutError"
+        finally:
+            c1.close()
+            c2.close()
+
+
+# -- plane over LoopbackTransport against real engines --------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    pt.seed(0)
+    return LlamaForCausalLM(tiny_llama_config())
+
+
+def _mk_engine(model):
+    return ServingEngine(model, num_slots=4, max_length=128,
+                         prefill_batch=2, paged=True, block_len=8)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(5)
+    return [rng.integers(3, 90, size=n).tolist() for n in (7, 12, 9, 15)]
+
+
+@pytest.fixture(scope="module")
+def ref_tokens(tiny_model, prompts):
+    """Single-engine greedy reference the plane runs must match."""
+    eng = _mk_engine(tiny_model)
+    rids = [eng.submit(np.asarray(p, np.int32), max_new_tokens=8)
+            for p in prompts]
+    out = dict(eng.drain())
+    return [list(out[r]) for r in rids]
+
+
+def _mk_plane(model, policy="prefix", prefill=None):
+    workers = OrderedDict()
+    for i in range(2):
+        w = EngineWorker(_mk_engine(model), name=f"w{i}")
+        workers[f"w{i}"] = LoopbackTransport(w.handle, name=f"w{i}")
+    return MultiHostRouter(workers, policy=policy, prefill=prefill)
+
+
+def test_plane_parity_and_one_timeline(tiny_model, prompts, ref_tokens):
+    plane = _mk_plane(tiny_model)
+    rids = [plane.submit(p, max_new_tokens=8) for p in prompts]
+    out = dict(plane.drain())
+    assert [out[r] for r in rids] == ref_tokens
+    assert plane.step_traces <= 1
+    log = obs.get_request_log()
+    for rid in rids:
+        names = log.event_names(plane.request_uid(rid))
+        assert names[0] == "submitted" and "retired" in names
+        assert names.count("submitted") == 1
+
+
+def test_worker_loss_failover_keeps_one_timeline(tiny_model, prompts,
+                                                 ref_tokens):
+    """Kill a worker mid-decode: every request still completes with the
+    reference tokens (recompute-from-prefix re-admission on survivors)
+    and the lifecycle stays ONE record per request_uid — submitted
+    once, worker_lost -> failover -> placed in order."""
+    plane = _mk_plane(tiny_model)
+    rids = [plane.submit(p, max_new_tokens=8) for p in prompts]
+    for _ in range(4):
+        plane.step()
+    victim = next(plane.worker_of(r) for r in rids
+                  if plane.worker_of(r) is not None)
+    plane._workers[victim].kill()
+    out = dict(plane.drain())
+    assert [out[r] for r in rids] == ref_tokens
+    assert list(plane.lost_workers) == [victim]
+    agg = plane.metrics()["aggregate"]
+    assert agg["failovers"] >= 1
+    log = obs.get_request_log()
+    saw_failover = False
+    for rid in rids:
+        names = log.event_names(plane.request_uid(rid))
+        assert names.count("submitted") == 1, names
+        if "failover" in names:
+            saw_failover = True
+            i_lost = names.index("worker_lost")
+            i_fo = names.index("failover")
+            i_placed = [j for j, n in enumerate(names) if n == "placed"]
+            assert i_lost < i_fo < max(i_placed), names
+    assert saw_failover
+
+
+def test_disagg_migration_token_identical(tiny_model, prompts,
+                                          ref_tokens):
+    """disagg policy: w0 prefills, requests migrate to w1 after the
+    first token via export_blocks/import_blocks over the transport —
+    outputs stay token-identical and the migrated bytes are counted."""
+    plane = _mk_plane(tiny_model, policy="disagg", prefill=["w0"])
+    rids = [plane.submit(p, max_new_tokens=8) for p in prompts]
+    out = dict(plane.drain())
+    assert [out[r] for r in rids] == ref_tokens
+    agg = plane.metrics()["aggregate"]
+    assert agg["migrations"] >= 1 and agg["migration_bytes"] > 0
+    assert plane.step_traces <= 1
+    log = obs.get_request_log()
+    migrated = 0
+    for rid in rids:
+        names = log.event_names(plane.request_uid(rid))
+        assert names.count("submitted") == 1
+        if "migrated" in names:
+            migrated += 1
+            # the migration happens inside the one lifecycle record,
+            # after placement on the prefill worker
+            assert names.index("migrated") > names.index("placed")
+    assert migrated == agg["migrations"]
+
+
+# -- streaming front end over a real HTTP server --------------------------
+
+@pytest.fixture()
+def http_run(tiny_model, prompts, ref_tokens):
+    """One /v1/generate streaming session against a live server plus
+    the /requests probes, captured while the server is up."""
+    plane = _mk_plane(tiny_model)
+    fe = ServingFrontend(plane)
+    srv = fe.serve(port=-1)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        body = json.dumps({"prompt": prompts[0], "max_new_tokens": 8})
+        conn.request("POST", "/v1/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        lines, buf = [], b""
+        retired_at_first_chunk = None
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                objd = json.loads(line)
+                lines.append(objd)
+                if "tokens" in objd and retired_at_first_chunk is None:
+                    rid = lines[0]["rid"]
+                    retired_at_first_chunk = plane._reqs[rid].done
+        conn.close()
+        uid = lines[0]["uid"]
+
+        def get(path):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=30)
+            c.request("GET", path)
+            r = c.getresponse()
+            out = (r.status, json.loads(r.read()))
+            c.close()
+            return out
+
+        probes = {"uid": get(f"/requests?uid={uid}"),
+                  "missing": get("/requests?uid=999999"),
+                  "limit": get("/requests?limit=1")}
+        yield {"lines": lines, "uid": uid,
+               "retired_at_first_chunk": retired_at_first_chunk,
+               "probes": probes}
+    finally:
+        srv.stop()
+        fe.stop()
+
+
+def test_streaming_generate_first_chunk_before_retirement(http_run,
+                                                          ref_tokens):
+    lines = http_run["lines"]
+    toks = [t for ln in lines if "tokens" in ln for t in ln["tokens"]]
+    assert toks == ref_tokens[0]
+    done = lines[-1]
+    assert done.get("done") is True and done["tokens_total"] == len(toks)
+    # tokens surface per tick, not in one blob at retirement
+    assert len([ln for ln in lines if "tokens" in ln]) > 1
+    # streaming TTFT is first-chunk-on-wire: the request was still
+    # in flight when its first token chunk was read off the socket
+    assert http_run["retired_at_first_chunk"] is False
+
+
+def test_requests_endpoint_uid_lookup(http_run):
+    status, tl = http_run["probes"]["uid"]
+    assert status == 200 and tl["found"] and tl["uid"] == http_run["uid"]
+    names = [ev["name"] for ev in tl["events"]]
+    assert names[0] == "submitted" and "retired" in names
+    # unknown uid: 404 with found=false, not an empty 200
+    status, missing = http_run["probes"]["missing"]
+    assert status == 404 and missing["found"] is False
+
+
+def test_requests_endpoint_bounded_limit(http_run):
+    status, tail = http_run["probes"]["limit"]
+    assert status == 200
+    assert tail["limit"] == 1 and len(tail["requests"]) <= 1
