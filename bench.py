@@ -2581,6 +2581,186 @@ def _disagg_serving_bench(model, on_tpu):
                      "moves exact KV blocks)"}}
 
 
+def _multihost_obs_bench(model, on_tpu):
+    """Federated observability cost + fidelity over a 2-worker loopback
+    plane (ISSUE 19), measured under INJECTED simulated clocks so every
+    figure but the federation wall cost is device-free deterministic:
+
+    * **federation overhead per tick** — the same seeded trace driven
+      twice, once bare and once with a full ``federation().merged()``
+      pull every plane tick; the row reports the per-pull wall cost and
+      its fraction of a bare plane tick (the scrape-budget number an
+      operator needs);
+    * **offset-estimate error under sim clocks** — each worker's server
+      clock runs at a fixed injected skew; the recovered NTP-style
+      offset must sit within the estimator's own min-RTT error bound of
+      the truth (gated);
+    * **pooled vs per-worker p99 agreement** — the federated pooled
+      TTFT p99 (recomputed from summed buckets) must land inside the
+      envelope of the per-worker p99s (pooling can never manufacture a
+      quantile outside its inputs — gated);
+    * byte-stable ``fleet_obs_signature`` across two identical-seed
+      bare replays (gated), step_traces <= 1."""
+    from collections import OrderedDict
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability.federation import percentile_from_buckets
+    from paddle_tpu.serving import LoadSpec, ServingEngine, generate_load
+    from paddle_tpu.serving.multihost import (EngineWorker,
+                                              LoopbackTransport,
+                                              MultiHostRouter)
+
+    # fresh registry: the exact federated-total arithmetic (and the
+    # jit.traces budget readout) must not inherit coalesced children
+    # from earlier sections (the loadgen --smoke hazard)
+    obs.reset()
+    log = obs.get_request_log()
+
+    if on_tpu:
+        n_req, slots, max_len, bl = 16, 8, 2048, 64
+    else:  # plumbing smoke: tiny trace, the gates still bind
+        n_req, slots, max_len, bl = 8, 4, 160, 8
+    seed = 29
+    skews = {"w0": 41.0, "w1": -23.0}      # ms each worker clock leads
+    spec = LoadSpec(n_requests=n_req, vocab=model.config.vocab_size,
+                    arrival="poisson", mean_gap=1.0,
+                    prompt_dist="zipf", prompt_buckets=(8, 16, 32),
+                    prompt_min=4, prompt_max=32,
+                    output_dist="zipf", output_buckets=(4, 8, 16),
+                    output_min=4, output_max=16,
+                    tenants=2, shared_prefix_len=4)
+    load = generate_load(spec, seed=seed)
+    order = sorted(range(len(load)),
+                   key=lambda i: (load[i].arrival, load[i].index))
+
+    def run(federate_every_tick):
+        saved_clock, saved_t0 = log._clock, log._t0
+        cell = {"t": 0.0}
+
+        def vclock():                       # 0.1 virtual ms per read
+            cell["t"] += 1e-4
+            return cell["t"]
+
+        log._clock, log._t0 = vclock, 0.0
+        try:
+            workers, engines = OrderedDict(), []
+            for i in range(2):
+                nm = f"w{i}"
+                eng = ServingEngine(model, num_slots=slots,
+                                    max_length=max_len, prefill_batch=2,
+                                    paged=True, block_len=bl)
+                eng._clock = vclock
+                engines.append(eng)
+                w = EngineWorker(eng, name=nm)
+                workers[nm] = LoopbackTransport(
+                    w.handle, name=nm,
+                    server_clock=(lambda s=skews[nm]:
+                                  log.now_ms() + s))
+            plane = MultiHostRouter(workers, policy="prefix")
+            mark = log.mark()
+            rids = {}
+            tick = nxt = 0
+            fed_wall = 0.0
+            pulls = 0
+            t0 = time.perf_counter()
+            while nxt < len(order) or any(not r.done
+                                          for r in plane._reqs.values()):
+                while (nxt < len(order)
+                       and load[order[nxt]].arrival <= tick):
+                    r = load[order[nxt]]
+                    rids[r.index] = plane.submit(
+                        r.prompt, max_new_tokens=r.max_new_tokens)
+                    nxt += 1
+                plane.step()
+                tick += 1
+                if federate_every_tick:
+                    f0 = time.perf_counter()
+                    plane.federation().merged()
+                    fed_wall += time.perf_counter() - f0
+                    pulls += 1
+            wall = time.perf_counter() - t0
+            end_mark = log.mark()
+            return {"plane": plane, "ticks": tick,
+                    "mark": mark, "end_mark": end_mark,
+                    "wall_s": wall, "fed_wall_s": fed_wall,
+                    "pulls": pulls,
+                    "step_traces": max(e.step_traces for e in engines),
+                    "signature": plane.fleet_obs_signature(
+                        since_uid=mark, until_uid=end_mark)}
+        finally:
+            log._clock, log._t0 = saved_clock, saved_t0
+
+    base1 = run(federate_every_tick=False)
+    base2 = run(federate_every_tick=False)  # determinism arm
+    fed = run(federate_every_tick=True)
+
+    base_tick_ms = base1["wall_s"] / max(1, base1["ticks"]) * 1e3
+    pull_ms = fed["fed_wall_s"] / max(1, fed["pulls"]) * 1e3
+
+    # offset recovery vs the injected truth (from the bare arm)
+    offsets = {}
+    offset_ok = True
+    worst_err = 0.0
+    for nm, t in base1["plane"]._workers.items():
+        est = t.stitch.estimator
+        err = abs(est.offset_ms - skews[nm])
+        worst_err = max(worst_err, err)
+        within = est.ready and err <= est.error_bound_ms + 1e-9
+        offset_ok = offset_ok and within
+        offsets[nm] = {"injected_skew_ms": skews[nm],
+                       "recovered_ms": round(est.offset_ms, 6),
+                       "error_ms": round(err, 6),
+                       "min_rtt_bound_ms": round(est.error_bound_ms, 6),
+                       "within_bound": bool(within)}
+
+    # pooled vs per-worker p99: the pooled quantile (summed buckets)
+    # must land inside the per-worker envelope
+    merged = base1["plane"].federation().merged()
+    fam = merged.get("serving.ttft_ms", {})
+    pooled_p99 = worker_p99 = None
+    envelope_ok = None
+    if fam.get("series"):
+        pooled_p99 = percentile_from_buckets(
+            fam["pooled"]["buckets"], 0.99)
+        worker_p99 = {
+            row["labels"]["worker"]: round(
+                percentile_from_buckets(row["buckets"], 0.99), 6)
+            for row in fam["series"]
+            if row.get("count") and "worker" in row["labels"]}
+        if pooled_p99 is not None and worker_p99:
+            lo, hi = min(worker_p99.values()), max(worker_p99.values())
+            envelope_ok = bool(lo - 1e-9 <= pooled_p99 <= hi + 1e-9)
+            pooled_p99 = round(pooled_p99, 6)
+
+    deterministic = base1["signature"] == base2["signature"]
+    return {
+        "trace": {"seed": seed, "requests": n_req, "workers": 2,
+                  "ticks": base1["ticks"]},
+        "federation_overhead": {
+            "pulls": fed["pulls"],
+            "per_pull_ms": round(pull_ms, 4),
+            "bare_tick_ms": round(base_tick_ms, 4),
+            "frac_of_tick": round(pull_ms / base_tick_ms, 4)
+            if base_tick_ms else None},
+        "clock_offsets": offsets,
+        "offset_within_bound": bool(offset_ok),
+        "offset_worst_error_ms": round(worst_err, 6),
+        "pooled_ttft_p99_ms_sim": pooled_p99,
+        "worker_ttft_p99_ms_sim": worker_p99,
+        "pooled_p99_within_worker_envelope": envelope_ok,
+        "deterministic_replay": bool(deterministic),
+        "step_traces": max(base1["step_traces"], fed["step_traces"]),
+        "note": "virtual clocks: TTFT figures are sim-clock ms (reads "
+                "advance 0.1 ms), only federation_overhead is host "
+                "wall — BASELINE.md 'Fleet observability conventions'",
+        "tpu_recheck": None if on_tpu else {
+            "status": "pending_tpu",
+            "command": "bench.py --sections multihost_obs",
+            "claim": "federation per-pull cost stays a small fraction "
+                     "of a real device tick, and the offset/envelope "
+                     "gates hold with wall-clock RTTs"}}
+
+
 def _merge_decode_artifact(section_key, section):
     """Incremental write: each finished section lands on disk immediately,
     so a wedged later section (tunnel RPC hangs are real — round 5) never
@@ -2645,7 +2825,7 @@ def run_decode_bench(args):
     if want & {"prefill", "decode", "int8", "e2e", "serving",
                "spec_decode", "mesh_serving", "slo_serving",
                "int8_serving", "perf_model", "preempt_serving",
-               "control_plane", "disagg_serving"}:
+               "control_plane", "disagg_serving", "multihost_obs"}:
         model, params, n = _decode_model(max_pos=8192 if on_tpu else 512,
                                          on_tpu=on_tpu)
         pbytes = n * 2                                  # bf16 weights
@@ -2919,6 +3099,22 @@ def run_decode_bench(args):
               f"deterministic {ds['deterministic_replay']}",
               file=sys.stderr)
 
+    # -- federated observability over the multi-host plane ---------------
+    if "multihost_obs" in want:
+        print("[decode-bench] federated observability ...",
+              file=sys.stderr)
+        mo = _multihost_obs_bench(model, on_tpu)
+        _merge_decode_artifact(skey, {"multihost_obs": mo})
+        fo = mo["federation_overhead"]
+        print(f"multihost_obs: federation pull "
+              f"{fo['per_pull_ms']} ms ({fo['frac_of_tick']}x bare "
+              f"tick), offset err {mo['offset_worst_error_ms']} ms "
+              f"within bound {mo['offset_within_bound']}, pooled p99 "
+              f"in worker envelope "
+              f"{mo['pooled_p99_within_worker_envelope']}, "
+              f"deterministic {mo['deterministic_replay']}",
+              file=sys.stderr)
+
     # -- mesh-sharded serving: mp engine + dp router A/B -----------------
     if "mesh_serving" in want:
         print("[decode-bench] mesh serving A/B ...", file=sys.stderr)
@@ -3083,8 +3279,11 @@ def main():
                          "replica-autoscaler trace + device-free fleet-"
                          "simulator scale row and the 'disagg_serving' "
                          "colocated-vs-disaggregated multi-host plane "
-                         "A/B on per-worker simulated clocks; implies "
-                         "--decode")
+                         "A/B on per-worker simulated clocks and the "
+                         "'multihost_obs' federated-observability row "
+                         "(federation pull cost, clock-offset recovery "
+                         "under injected skews, pooled-vs-per-worker "
+                         "p99 agreement); implies --decode")
     ap.add_argument("--check-history", action="store_true",
                     dest="check_history",
                     help="perf-regression gate: validate the committed "

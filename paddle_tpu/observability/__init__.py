@@ -21,7 +21,13 @@ hardware allows") requires as a *layer*, not per-module counters:
     ``slo_report()`` goodput-under-deadline readout;
   * :mod:`.watchdog` — ``track_retraces``: per-call-site jit trace
     counting with a budget, generalising the engine's
-    ``step_traces == 1`` contract into a reusable, CI-armed guarantee.
+    ``step_traces == 1`` contract into a reusable, CI-armed guarantee;
+  * :mod:`.federation` — the fleet tier: merges worker registry
+    snapshots into one federated view (``worker=`` labels, pooled
+    percentiles from merged buckets, post-merge cardinality cap),
+    recovers per-worker clock offsets from RPC timestamps (NTP-style
+    min-RTT estimator) and exports ONE merged Perfetto timeline for
+    plane + workers + requests.
 
 Conventions: metric names are dotted lowercase (``serving.ttft_ms``);
 millisecond histograms carry the ``_ms`` suffix; per-instance series are
@@ -32,6 +38,10 @@ from .costmodel import (CostModel, HardwareProfile, PROFILES,
                         TickAttribution, kv_bytes_per_token, perf_signature,
                         resolve_profile)
 from .costmodel import reset as _reset_costmodel
+from .federation import (ClockOffsetEstimator, FederatedRegistry,
+                         TransportStitch, fleet_obs_signature,
+                         merge_perfetto, percentile_from_buckets,
+                         scope_snapshot)
 from .http_exposition import ExpositionServer, maybe_serve
 from . import metrics as _metrics_mod
 from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS_MS,
@@ -58,6 +68,9 @@ __all__ = [
     "TickAttribution", "kv_bytes_per_token", "perf_signature",
     "EwmaDetector", "HISTORY_TOLERANCES", "check_history",
     "ExpositionServer", "maybe_serve",
+    "ClockOffsetEstimator", "FederatedRegistry", "TransportStitch",
+    "scope_snapshot", "percentile_from_buckets", "merge_perfetto",
+    "fleet_obs_signature",
 ]
 
 

@@ -300,6 +300,7 @@ class TickAttribution:
         with self._lock:
             self.model.clear()
             self._ticks = 0
+            self.last_ratio: Optional[float] = None
             self._measured_ms = 0.0
             self._bounds: Dict[str, Dict[str, float]] = {}
             self._terms = {"weight_stream_ms": 0.0, "kv_stream_ms": 0.0,
@@ -347,6 +348,7 @@ class TickAttribution:
         ratio = float(measured_ms) / max(pred["predicted_ms"], 1e-12)
         with self._lock:
             self._ticks += 1
+            self.last_ratio = ratio
             self._measured_ms += float(measured_ms)
             agg = self._bounds.setdefault(
                 bound, {"ticks": 0, "predicted_ms_sum": 0.0})

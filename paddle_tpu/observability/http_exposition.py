@@ -3,7 +3,13 @@
 Serves the observability layer over HTTP for a scraping/poking
 operator, with zero dependencies beyond ``http.server``:
 
-  * ``/metrics``  — the registry's Prometheus text exposition,
+  * ``/metrics``  — the registry's Prometheus text exposition; when an
+    attached engine is a multihost plane, the federated per-worker
+    series ride along under the ``paddle_tpu_fleet_`` prefix,
+  * ``/fleet``    — live fleet health from the attached plane (per-
+    worker heartbeat age in ticks, in-flight slots, utilization,
+    last-step cost-model ratio, transport error counts; 404 when no
+    attached engine exposes ``fleet_report()``),
   * ``/healthz``  — JSON liveness: engine step-trace budgets, perf
     anomaly totals, drift-finding counts (a load balancer's readiness
     answer in one GET),
@@ -55,8 +61,16 @@ class _Handler(BaseHTTPRequestHandler):
         owner: "ExpositionServer" = self.server.owner  # type: ignore
         url = urlparse(self.path)
         if url.path == "/metrics":
-            text = owner.registry.prometheus_text()
+            text = owner.metrics_text()
             self._send(200, text.encode(), "text/plain; version=0.0.4")
+        elif url.path == "/fleet":
+            payload = owner.fleet()
+            if payload is None:
+                self._send(404, b'{"error": "no fleet source"}\n',
+                           "application/json")
+                return
+            body = json.dumps(payload, sort_keys=True, default=str)
+            self._send(200, body.encode(), "application/json")
         elif url.path == "/healthz":
             body = json.dumps(owner.healthz(), sort_keys=True)
             self._send(200, body.encode(), "application/json")
@@ -142,6 +156,30 @@ class ExpositionServer:
         return max(0, self._requested_port)
 
     # -- payloads ------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """The /metrics page: the process registry's exposition plus,
+        when any attached engine is a fleet plane (duck-typed on
+        ``federated_metrics_text``), the federated worker series under
+        the ``paddle_tpu_fleet_`` prefix."""
+        text = self.registry.prometheus_text()
+        for e in self.engines:
+            fed = getattr(e, "federated_metrics_text", None)
+            if callable(fed):
+                try:
+                    text += fed()
+                except Exception:
+                    pass            # a half-lost fleet still scrapes
+        return text
+
+    def fleet(self) -> Optional[Dict[str, Any]]:
+        """The /fleet payload from the first attached engine exposing
+        ``fleet_report()`` (the multihost plane); None -> 404."""
+        for e in self.engines:
+            fr = getattr(e, "fleet_report", None)
+            if callable(fr):
+                return fr()
+        return None
 
     def healthz(self) -> Dict[str, Any]:
         anomalies = 0.0

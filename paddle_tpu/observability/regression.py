@@ -371,6 +371,27 @@ def check_history(root: Optional[str] = None,
                 f"replicas in {fl.get('host_wall_s')} s host "
                 f"(sim {fl.get('sim_wall_s')} s)"))
 
+    # multihost_obs (ISSUE 19): the committed federated-observability
+    # row must keep its fidelity invariants — every worker's recovered
+    # clock offset inside the estimator's own min-RTT error bound, the
+    # pooled TTFT p99 (recomputed from summed buckets) inside the
+    # per-worker p99 envelope, byte-stable fleet-obs signature across
+    # identical-seed replays, and the surviving once-jit budget
+    mo = cpu.get("multihost_obs", {})
+    if mo:
+        ok = (bool(mo.get("offset_within_bound"))
+              and mo.get("pooled_p99_within_worker_envelope") is not False
+              and bool(mo.get("deterministic_replay"))
+              and int(mo.get("step_traces", 99)) <= 1)
+        checks.append(_check(
+            "multihost_obs_row", ok,
+            f"offset_within_bound={mo.get('offset_within_bound')} "
+            f"(worst err {mo.get('offset_worst_error_ms')} ms) "
+            f"pooled_p99_in_envelope="
+            f"{mo.get('pooled_p99_within_worker_envelope')} "
+            f"deterministic={mo.get('deterministic_replay')} "
+            f"step_traces={mo.get('step_traces')}"))
+
     ok = all(c["ok"] is not False for c in checks)
     return {"ok": ok, "root": root, "tolerances": tol, "checks": checks}
 

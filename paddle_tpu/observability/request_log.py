@@ -90,6 +90,11 @@ class RequestLog:
             OrderedDict()
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        # the clock seam: event timestamps read (self._clock() - _t0).
+        # Simulated fleets swap both for a virtual clock so timelines
+        # (and, through transport._default_clock_ms, RPC stitching)
+        # replay byte-deterministically.
+        self._clock = time.perf_counter
         self._t0 = time.perf_counter()
 
     # -- recording ---------------------------------------------------------
@@ -110,12 +115,21 @@ class RequestLog:
         with self._lock:
             return self._last_uid
 
-    def event(self, uid: int, name: str, **attrs: Any) -> None:
+    def now_ms(self) -> float:
+        """This log's relative clock reading (ms) — the base every
+        event timestamp, and the plane/worker RPC stitch, shares."""
+        return (self._clock() - self._t0) * 1e3
+
+    def event(self, uid: int, name: str, t_ms: Optional[float] = None,
+              **attrs: Any) -> None:
         """Append one lifecycle event and mirror it into the span
         tracer as a ``request.<name>`` instant with ``uid`` as the
-        correlation arg."""
-        t_ms = (time.perf_counter() - self._t0) * 1e3
-        ev = {"name": name, "t_ms": t_ms, "attrs": dict(attrs)}
+        correlation arg.  ``t_ms`` overrides the stamp — how a plane
+        merges a worker's shipped events at their clock-stitched plane
+        time instead of their arrival time."""
+        if t_ms is None:
+            t_ms = self.now_ms()
+        ev = {"name": name, "t_ms": float(t_ms), "attrs": dict(attrs)}
         with self._lock:
             rec = self._records.get(uid)
             if rec is None:
@@ -257,7 +271,15 @@ class RequestLog:
         — rejected-style: in the denominator, never attaining), else a
         missed TTFT to its larger segment (``queue_wait`` vs
         ``prefill``), else a missed TPOT to ``decode``; a request still
-        in flight counts as ``incomplete`` (never SLO-attaining)."""
+        in flight counts as ``incomplete`` (never SLO-attaining).
+
+        Fleet attribution (ISSUE 19): when timelines carry placement
+        (``placed``/``migrated`` with a ``worker`` attr, or engine
+        events), the report gains a ``by_worker`` section attributing
+        every request's outcome to its LAST hosting worker (the one a
+        migrated/failed-over request retired on) — the same join works
+        for a multihost plane on the plane clock and for ``FleetSim``'s
+        per-replica simulated clocks (keyed ``engine:<id>`` there)."""
         recs = self.records(since_uid, until_uid)
         total = len(recs)
         attained = 0
@@ -266,11 +288,32 @@ class RequestLog:
         tpots: List[float] = []
         viol = {"rejected": 0, "cancelled": 0, "queue_wait": 0,
                 "prefill": 0, "decode": 0, "incomplete": 0}
+        by_worker: Dict[str, Dict[str, Any]] = {}
+
+        def tally(wkey: Optional[str], outcome: str) -> None:
+            if wkey is None:
+                return
+            w = by_worker.setdefault(
+                wkey, {"requests": 0, "attained": 0, "violations": {}})
+            w["requests"] += 1
+            if outcome == "attained":
+                w["attained"] += 1
+            else:
+                w["violations"][outcome] = \
+                    w["violations"].get(outcome, 0) + 1
+
         recorded_targets = set()
         for rec in recs.values():
             by = {}
+            wkey: Optional[str] = None
             for ev in rec:
                 by.setdefault(ev["name"], ev["attrs"])
+                if ev["name"] in ("placed", "migrated") \
+                        and ev["attrs"].get("worker") is not None:
+                    wkey = str(ev["attrs"]["worker"])
+                elif wkey is None \
+                        and ev["attrs"].get("engine") is not None:
+                    wkey = f"engine:{ev['attrs']['engine']}"
             sub = by.get("submitted", {})
             t_ttft = (float(sub.get("ttft_slo_ms", 0.0))
                       if ttft_ms is None else float(ttft_ms))
@@ -279,13 +322,16 @@ class RequestLog:
             recorded_targets.add((t_ttft, t_tpot))
             if "rejected" in by and "admitted" not in by:
                 viol["rejected"] += 1
+                tally(wkey, "rejected")
                 continue
             ret = by.get("retired")
             if ret is None:
                 viol["incomplete"] += 1
+                tally(wkey, "incomplete")
                 continue
             if ret.get("reason") == "cancelled":
                 viol["cancelled"] += 1
+                tally(wkey, "cancelled")
                 continue
             ttft = ret.get("ttft_ms")
             tpot = ret.get("tpot_ms")
@@ -304,8 +350,10 @@ class RequestLog:
             if kind is None:
                 attained += 1
                 attained_tokens += int(ret.get("tokens", 0))
+                tally(wkey, "attained")
             else:
                 viol[kind] += 1
+                tally(wkey, kind)
 
         def dist(vals):
             return {"count": len(vals),
@@ -329,6 +377,9 @@ class RequestLog:
             "violations": viol,
             "ttft_ms": dist(ttfts),
             "tpot_ms": dist(tpots)}
+        if by_worker:
+            out["by_worker"] = {k: by_worker[k]
+                                for k in sorted(by_worker)}
         if wall_s:
             out["goodput_tok_s"] = round(attained_tokens / wall_s, 1)
         return out

@@ -441,6 +441,27 @@ class FleetSim:
         """Fleet simulated wall: the slowest replica's clock."""
         return max(e.sim_time_s for e in self.engines)
 
+    def worker_clocks(self) -> Dict[str, float]:
+        """Per-replica simulated clocks in ms, keyed ``replica<i>`` —
+        the fleet-sim analogue of the multihost plane's stitched
+        per-worker clocks.  No wire time is modelled, so these ARE the
+        exact offsets a plane-side estimator would recover (BASELINE.md
+        "Fleet observability conventions")."""
+        return {f"replica{i}": round(e.sim_time_s * 1e3, 6)
+                for i, e in enumerate(self.engines)}
+
+    def slo_by_worker(self, slo: Dict[str, Any]) -> Dict[str, Any]:
+        """A replay report's ``by_worker`` SLO attribution re-keyed
+        from per-process ``engine:<id>`` onto run-stable ``replica<i>``
+        names — the same federated attribution the multihost plane
+        reports keyed by worker name, proving the one slo_report code
+        path serves both clock domains."""
+        eid_to_replica = {f"engine:{e._eid}": f"replica{i}"
+                          for i, e in enumerate(self.engines)}
+        byw = slo.get("by_worker") or {}
+        return {eid_to_replica.get(k, k): v
+                for k, v in sorted(byw.items())}
+
     def report(self) -> Dict[str, Any]:
         return {
             "spec": dataclasses.asdict(self.spec),
@@ -556,6 +577,11 @@ def run_fleet(*, requests: int = 100_000, replicas: int = 16,
             "sim_tok_per_s": round(
                 rep["generated_tokens"] / max(fleet.sim_wall_s, 1e-9), 3),
             "goodput": rep["slo"].get("goodput"),
+            # federated attribution under simulated clocks (ISSUE 19):
+            # the same slo_report by_worker join the multihost plane
+            # uses, re-keyed onto run-stable replica names
+            "slo_by_worker": fleet.slo_by_worker(rep["slo"]),
+            "worker_clocks_ms": fleet.worker_clocks(),
             "fleet": fleet.report(),
             "signature": fleet_signature(fleet, rep),
         }

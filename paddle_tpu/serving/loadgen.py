@@ -368,6 +368,7 @@ def _smoke() -> int:
                 len(set(preempt_sigs)) <= 1)
     summary["fleet_sim"] = _smoke_fleet_sim(model, load, failures)
     summary["multihost"] = _smoke_multihost(model, load, failures)
+    summary["federated"] = _smoke_federated(model, load, failures)
     summary["failures"] = failures
     print(json.dumps(summary, indent=2))
     return 1 if failures else 0
@@ -549,6 +550,189 @@ def _smoke_multihost(model, load: Sequence[LoadRequest],
                  "outputs_match_no_kill": kill_outputs == a["outputs"],
                  "one_timeline_per_uid": one_timeline},
     }
+
+
+def _smoke_federated(model, load: Sequence[LoadRequest],
+                     failures: List[str]) -> Dict[str, Any]:
+    """ISSUE 19 CI gates for the federated observability layer, run
+    over a 2-worker loopback plane under INJECTED deterministic clocks
+    (every time source — the request log, the engines, the transports'
+    server clocks — reads one virtual counter, with a fixed per-worker
+    skew on the server side so the NTP-style estimator has real work):
+
+    * federated ``/metrics`` counter totals must EXACTLY equal the sum
+      of the per-worker (engine-scoped) registry series;
+    * each transport's recovered clock offset must sit within the
+      min-RTT error bound of its injected skew;
+    * the merged timeline must be valid Perfetto JSON carrying the
+      plane track, BOTH worker process tracks, rpc.call slices split
+      into wire/in_worker, and per-request hop tracks;
+    * the fleet-obs signature must replay byte-stable across two
+      identical-seed runs;
+    * one real HTTP GET each of /metrics and /fleet must serve the
+      federated exposition and a healthy roster with tick-accurate
+      heartbeat ages."""
+    import urllib.request
+    from collections import OrderedDict
+
+    from ..observability.http_exposition import ExpositionServer
+    from .engine import ServingEngine
+    from .multihost import EngineWorker, LoopbackTransport, MultiHostRouter
+
+    # same reasoning as the multihost leg: fresh engines near the
+    # cardinality cap would coalesce, and a coalesced registry breaks
+    # the exact federated-total equality this leg gates
+    _obs.reset()
+    log = _obs.get_request_log()
+    skews = {"w0": 37.0, "w1": -53.0}       # ms the worker clock leads
+    out: Dict[str, Any] = {"skews_ms": dict(skews)}
+
+    def run_once(http_leg: bool) -> Dict[str, Any]:
+        saved_clock, saved_t0 = log._clock, log._t0
+        cell = {"t": 0.0}
+
+        def vclock() -> float:              # virtual seconds; each read
+            cell["t"] += 1e-4               # advances 0.1 ms
+            return cell["t"]
+
+        log._clock, log._t0 = vclock, 0.0
+        try:
+            workers = OrderedDict()
+            engines = []
+            for i in range(2):
+                n = f"w{i}"
+                eng = ServingEngine(model, num_slots=4, max_length=128,
+                                    prefill_batch=2, paged=True,
+                                    block_len=8)
+                eng._clock = vclock         # SLO stamps off the wall too
+                engines.append(eng)
+                w = EngineWorker(eng, name=n)
+                workers[n] = LoopbackTransport(
+                    w.handle, name=n,
+                    server_clock=(lambda s=skews[n]: log.now_ms() + s))
+            plane = MultiHostRouter(workers, policy="prefix")
+            rep = replay(plane, load)
+            r: Dict[str, Any] = {"ticks": rep["ticks"]}
+
+            # federated totals == sum of the per-worker registry series
+            fed = plane.federation()
+            merged = fed.merged()
+            eids = {str(e._eid) for e in engines}
+            proc = _obs.snapshot()
+            bad = []
+            n_counters = 0
+            for name, fam in merged.items():
+                if name in ("schema_version", "workers") \
+                        or fam["type"] != "counter":
+                    continue
+                n_counters += 1
+                want = sum(float(row["value"])
+                           for row in proc[name]["series"]
+                           if str(row["labels"].get("engine", ""))
+                           in eids)
+                got = float(fam["pooled"]["value"])
+                if got != want:
+                    bad.append(f"{name}: federated {got} != sum of "
+                               f"worker registries {want}")
+            if not n_counters:
+                bad.append("no counter families federated at all")
+            if bad:
+                failures.append("federated: " + "; ".join(bad))
+            r["counter_families"] = n_counters
+            r["counter_totals_equal"] = not bad
+
+            # recovered offsets within the min-RTT bound of the skew
+            offs = {}
+            for n, t in plane._workers.items():
+                est = t.stitch.estimator
+                err = abs(est.offset_ms - skews[n])
+                offs[n] = {"offset_ms": round(est.offset_ms, 6),
+                           "error_ms": round(err, 6),
+                           "bound_ms": round(est.error_bound_ms, 6)}
+                if not est.ready or err > est.error_bound_ms + 1e-9:
+                    failures.append(
+                        f"federated: {n} recovered offset "
+                        f"{est.offset_ms} is outside the min-RTT bound "
+                        f"of the injected skew {skews[n]}")
+            r["offsets"] = offs
+
+            # one merged, valid Perfetto timeline with every track kind
+            trace = plane.export_merged_perfetto(
+                since_uid=rep["mark"], until_uid=rep["end_mark"])
+            import json as _json
+            _json.dumps(trace)              # valid Perfetto JSON
+            evs = trace["traceEvents"]
+            procs = {e["args"]["name"] for e in evs
+                     if e.get("name") == "process_name"}
+            structure = {
+                "worker_tracks": {"paddle_tpu worker w0",
+                                  "paddle_tpu worker w1"} <= procs,
+                "plane_track": "paddle_tpu plane" in procs,
+                "rpc_split": (
+                    any(str(e.get("name", "")).startswith("rpc.call:")
+                        for e in evs)
+                    and any(e.get("name") == "wire" for e in evs)
+                    and any(e.get("name") == "in_worker" for e in evs)),
+                "request_tracks": any(
+                    str(e.get("name", "")).startswith("on w")
+                    for e in evs)}
+            if not all(structure.values()):
+                failures.append(
+                    f"federated: merged timeline is missing tracks: "
+                    f"{[k for k, v in structure.items() if not v]}")
+            r["merged_timeline"] = structure
+
+            # tick-accurate heartbeat ages + live roster
+            fleet = plane.fleet_report()
+            hb = plane._hb_every
+            exp_age = plane._ticks - hb * ((plane._ticks - 1) // hb)
+            ages = {n: w["heartbeat_age_ticks"]
+                    for n, w in fleet["workers"].items()}
+            if not all(w["alive"] for w in fleet["workers"].values()):
+                failures.append("federated: a loopback worker reported "
+                                "dead on a clean run")
+            if any(a != exp_age for a in ages.values()):
+                failures.append(
+                    f"federated: heartbeat ages {ages} are not tick-"
+                    f"accurate (expected {exp_age} after "
+                    f"{plane._ticks} ticks, heartbeat_every={hb})")
+            r["heartbeat_age_ticks"] = ages
+
+            r["signature"] = plane.fleet_obs_signature(
+                since_uid=rep["mark"], until_uid=rep["end_mark"])
+
+            if http_leg:
+                with ExpositionServer(port=-1, engines=[plane]) as srv:
+                    base = f"http://127.0.0.1:{srv.port}"
+                    text = urllib.request.urlopen(
+                        base + "/metrics", timeout=10).read().decode()
+                    fl = _json.loads(urllib.request.urlopen(
+                        base + "/fleet", timeout=10).read().decode())
+                http_ok = {
+                    "metrics_has_fleet_prefix":
+                        "paddle_tpu_fleet_" in text,
+                    "metrics_has_worker_labels":
+                        'worker="w0"' in text and 'worker="w1"' in text,
+                    "fleet_reports_both_workers": all(
+                        fl["workers"].get(n, {}).get("alive")
+                        for n in ("w0", "w1"))}
+                if not all(http_ok.values()):
+                    failures.append(
+                        f"federated: HTTP exposition gaps: "
+                        f"{[k for k, v in http_ok.items() if not v]}")
+                r["http"] = http_ok
+            return r
+        finally:
+            log._clock, log._t0 = saved_clock, saved_t0
+
+    a = run_once(http_leg=True)
+    b = run_once(http_leg=False)
+    if a["signature"] != b["signature"]:
+        failures.append("federated: fleet-obs signature drift between "
+                        "identical-seed replays")
+    out.update(a)
+    out["signature_stable"] = a["signature"] == b["signature"]
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -17,8 +17,10 @@ Two modes:
     token-identical to a single in-process reference engine, (c) every
     request has ONE lifecycle timeline (one ``submitted``, a
     ``retired``, and ``worker_lost -> failover -> placed`` in order on
-    the victims).  Exits non-zero on any parity or timeline drift —
-    the verify-skill hook for the real-process path.
+    the victims), and (d) the merged fleet Perfetto timeline (ISSUE 19)
+    contains both worker process tracks plus at least one stitched
+    cross-process request track.  Exits non-zero on any parity or
+    timeline drift — the verify-skill hook for the real-process path.
 """
 
 from __future__ import annotations
@@ -57,7 +59,11 @@ def _run_worker(args: argparse.Namespace) -> int:
     from .transport import RpcServer, StoreClient
     from .worker import EngineWorker
     worker = EngineWorker(_build_engine(), name=args.name)
-    rpc = RpcServer(worker.handle, host="127.0.0.1", port=0)
+    # the RPC server stamps t1/t2 with the worker's request-log clock,
+    # so the plane's offset estimate maps shipped events and handler
+    # slices onto the plane clock in one go (ISSUE 19)
+    rpc = RpcServer(worker.handle, host="127.0.0.1", port=0,
+                    clock=worker.clock_ms)
     store = StoreClient(args.store_host, args.store_port)
     store.set(f"worker/{args.name}",
               {"host": rpc.host, "port": rpc.port})
@@ -161,6 +167,23 @@ def _selfcheck(args: argparse.Namespace) -> int:
                 check(order == sorted(order),
                       f"uid {uid}: worker_lost -> failover -> placed order")
         check(saw_failover, "at least one request failed over")
+        # ISSUE 19: the merged fleet timeline over REAL processes must
+        # stitch both workers' clock domains onto the plane clock
+        trace = plane.export_merged_perfetto()
+        tracks = {e["args"]["name"] for e in trace["traceEvents"]
+                  if e.get("name") == "process_name"}
+        check({"paddle_tpu worker w0",
+               "paddle_tpu worker w1"} <= tracks,
+              "merged timeline carries both worker process tracks")
+        stitched = any(
+            str(e.get("name", "")).startswith("on w")
+            and e.get("ph") == "X"
+            for e in trace["traceEvents"])
+        check(stitched, "merged timeline has >= 1 stitched "
+                        "cross-process request track")
+        check(any(str(e.get("name", "")).startswith("rpc.call:")
+                  for e in trace["traceEvents"]),
+              "merged timeline splits rpc.call slices")
         plane.shutdown()
     finally:
         for p in procs:

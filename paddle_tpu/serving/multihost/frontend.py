@@ -71,9 +71,11 @@ class ServingFrontend:
         self.stop()
 
     def serve(self, port: int = -1) -> ExpositionServer:
-        """An ExpositionServer wired to this front end: /metrics,
-        /healthz, /requests (uid lookup included) and the streaming
-        POST /v1/generate, all on one port."""
+        """An ExpositionServer wired to this front end: /metrics (the
+        process exposition plus the plane's federated per-worker
+        series), /fleet (live per-worker health), /healthz, /requests
+        (uid lookup included) and the streaming POST /v1/generate, all
+        on one port."""
         self.start()
         return ExpositionServer(port=port, engines=[self.plane],
                                 generator=self).start()

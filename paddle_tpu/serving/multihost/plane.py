@@ -48,6 +48,7 @@ import numpy as np
 
 from ... import flags as _flags
 from ... import observability as _obs
+from ...observability import federation as _fed
 from ..engine import SamplingParams
 from .transport import RpcError, Transport, TransportError
 
@@ -148,6 +149,16 @@ class MultiHostRouter:
                 **lbl)
         self._m_heartbeats = reg.counter(
             "multihost.heartbeats", "heartbeat pings issued").labels(**lbl)
+        # fleet-health observability (ISSUE 19): per-worker heartbeat
+        # age in plane ticks and loss classification by reason
+        self._f_hb_age = reg.gauge(
+            "plane.heartbeat_age_ticks",
+            "plane ticks since the worker's last successful heartbeat")
+        self._f_worker_lost = reg.counter(
+            "plane.worker_lost",
+            "workers marked lost, by reason "
+            "(missed_heartbeat|transport_error)")
+        self._last_hb_tick: Dict[str, int] = {n: 0 for n in self._workers}
 
     # -- roster --------------------------------------------------------
 
@@ -168,6 +179,12 @@ class MultiHostRouter:
             return
         self._dead[name] = reason
         self._m_lost.inc()
+        # one reason label per loss class: a missed heartbeat is the
+        # silent kind, every other loss surfaced as a TransportError
+        self._f_worker_lost.labels(
+            plane=self._pid, worker=name,
+            reason=("missed_heartbeat" if reason == "heartbeat_failed"
+                    else "transport_error")).inc()
         self._status.pop(name, None)
         self._tracer.instant("multihost.worker_lost", worker=name,
                              reason=reason)
@@ -283,6 +300,7 @@ class MultiHostRouter:
                 self._m_heartbeats.inc()
                 try:
                     self._workers[name].call("ping", {})
+                    self._last_hb_tick[name] = self._ticks
                 except (TransportError, RpcError):
                     self._mark_lost(name, "heartbeat_failed")
         self._retry_pending_imports()
@@ -299,9 +317,19 @@ class MultiHostRouter:
             if not self._workers[name].shares_process:
                 # process-separated worker: merge its shipped request-
                 # log events so each uid keeps ONE lifecycle timeline
-                # in THIS process (loopback shares the log already)
+                # in THIS process (loopback shares the log already).
+                # Worker timestamps map onto the plane clock through
+                # the transport's stitched offset estimate; without an
+                # estimate yet they fall back to the arrival stamp.
+                st = getattr(self._workers[name], "stitch", None)
                 for ev in out.get("events", []):
+                    t_ms = ev.get("t_ms")
+                    if t_ms is not None and st is not None and st.ready:
+                        t_ms = st.to_plane_ms(float(t_ms))
+                    else:
+                        t_ms = None
                     self._rlog.event(int(ev["uid"]), str(ev["name"]),
+                                     t_ms=t_ms,
                                      **dict(ev.get("attrs") or {}))
             for wr, toks in out.get("deltas", {}).items():
                 rid = self._by_worker.get((name, int(wr)))
@@ -324,6 +352,12 @@ class MultiHostRouter:
         if self.policy == "disagg":
             self._run_migrations()
         self._ticks += 1
+        # gauge AFTER the tick count advances: the exported age matches
+        # what fleet_report computes between ticks, so a scrape and the
+        # /fleet endpoint never disagree by the in-tick off-by-one
+        for name in self.live_workers:
+            self._f_hb_age.labels(plane=self._pid, worker=name).set(
+                self._ticks - self._last_hb_tick.get(name, 0))
         return finished
 
     def _run_migrations(self) -> None:
@@ -541,6 +575,104 @@ class MultiHostRouter:
     def step_traces(self) -> int:
         return max([int(s.get("step_traces", 0))
                     for s in self._status.values()] or [0])
+
+    # -- federated observability (ISSUE 19) ----------------------------
+
+    def federation(self, full: bool = False) -> "_fed.FederatedRegistry":
+        """Pull every live worker's ``metrics_snapshot`` into one
+        :class:`FederatedRegistry` (engine-scoped snapshots, so the
+        federated totals equal the per-worker sums even when loopback
+        workers share a process registry)."""
+        fed = _fed.FederatedRegistry()
+        for name in self.live_workers:
+            try:
+                out = self._workers[name].call(
+                    "metrics_snapshot", {"full": bool(full)})
+            except TransportError:
+                self._mark_lost(name, "metrics_snapshot_failed")
+                continue
+            except RpcError:
+                continue
+            fed.add_snapshot(name, out["snapshot"])
+        return fed
+
+    def federated_metrics_text(self) -> str:
+        """The fleet half of the /metrics page: the merged worker
+        registries under the ``paddle_tpu_fleet_`` prefix (the serving
+        process's own ``paddle_tpu_`` exposition rides alongside)."""
+        return self.federation().prometheus_text()
+
+    def fleet_report(self) -> Dict[str, Any]:
+        """The /fleet endpoint payload: per-worker health (heartbeat
+        age in plane ticks, in-flight slots, utilization, last-step
+        cost-model ratio, transport error count) plus pooled figures
+        computed sum-over-sum (BASELINE hit-rate cross-check rule)."""
+        workers: Dict[str, Any] = {}
+        tot_active = tot_slots = 0
+        for name in self._workers:
+            st = self._status.get(name, {})
+            alive = name not in self._dead
+            slots = int(st.get("num_slots", 0) or 0)
+            active = int(st.get("num_active", 0))
+            if alive:
+                tot_active += active
+                tot_slots += slots
+            workers[name] = {
+                "alive": alive,
+                "reason": self._dead.get(name),
+                "heartbeat_age_ticks": (
+                    self._ticks - self._last_hb_tick.get(name, 0)
+                    if alive else None),
+                "in_flight": active,
+                "num_slots": slots,
+                "utilization": (round(active / slots, 4)
+                                if slots else 0.0),
+                "last_step_ratio": st.get("last_step_ratio"),
+                "queue_depth": int(st.get("queue_depth", 0)),
+                "transport_errors": int(
+                    getattr(self._workers[name], "errors", 0))}
+        return {
+            "plane": {"ticks": int(self._ticks), "policy": self.policy,
+                      "workers_lost": len(self._dead),
+                      "heartbeat_every": self._hb_every},
+            "workers": workers,
+            "pooled": {"in_flight": tot_active, "num_slots": tot_slots,
+                       "utilization": (round(tot_active / tot_slots, 4)
+                                       if tot_slots else 0.0)}}
+
+    def slo_report(self, since_uid: int = 0,
+                   until_uid: Optional[int] = None,
+                   **kw: Any) -> Dict[str, Any]:
+        """Federated SLO report: all workers' timelines are already
+        joined in the plane log on the plane clock (loopback shares it;
+        socket events arrive clock-stitched), so this is the request
+        log's report — including ``by_worker`` violation attribution —
+        scoped to the plane's requests."""
+        return self._rlog.slo_report(since_uid, until_uid, **kw)
+
+    def export_merged_perfetto(self, path: Optional[str] = None,
+                               since_uid: int = 0,
+                               until_uid: Optional[int] = None
+                               ) -> Dict[str, Any]:
+        """ONE merged Perfetto timeline for the fleet — see
+        :func:`~paddle_tpu.observability.federation.merge_perfetto`."""
+        stitches = OrderedDict(
+            (n, t.stitch) for n, t in self._workers.items()
+            if getattr(t, "stitch", None) is not None)
+        return _fed.merge_perfetto(
+            stitches, self._rlog.records(since_uid, until_uid),
+            path=path)
+
+    def fleet_obs_signature(self, since_uid: int = 0,
+                            until_uid: Optional[int] = None) -> str:
+        """Byte-stability probe over the fleet observability state
+        (merged timeline + wall-free federated metrics + health) — see
+        :func:`~paddle_tpu.observability.federation.
+        fleet_obs_signature`."""
+        return _fed.fleet_obs_signature(
+            self.export_merged_perfetto(since_uid=since_uid,
+                                        until_uid=until_uid),
+            self.federation().merged(), self.fleet_report())
 
     def metrics(self) -> Dict[str, Any]:
         agg = {

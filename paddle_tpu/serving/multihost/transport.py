@@ -28,6 +28,17 @@ Telemetry: every call increments ``rpc.calls`` / ``rpc.errors`` /
 ``rpc.retries`` and the byte counters, and opens an ``rpc.call``
 Perfetto span — label cardinality is bounded by transport name, not
 method.
+
+Clock stitching (ISSUE 19): every frame carries timestamps on both
+sides — the client stamps ``t0``/``t3`` (send/receive) on ITS clock
+into the request's ``ts`` field, the server answers with ``t1``/``t2``
+(receive/respond) on the WORKER clock — and each transport feeds the
+four into a :class:`~paddle_tpu.observability.federation.
+TransportStitch` (``transport.stitch``), whose min-RTT NTP-style
+estimator recovers the worker clock's offset from the plane clock.
+Clocks are pluggable (``clock=`` returns milliseconds; default is the
+request log's relative clock) so loopback planes and simulated fleets
+stitch deterministically.
 """
 
 from __future__ import annotations
@@ -44,6 +55,7 @@ import numpy as np
 
 from ... import flags as _flags
 from ... import observability as _obs
+from ...observability.federation import TransportStitch
 
 __all__ = [
     "encode_message", "decode_message", "RpcError", "TransportError",
@@ -54,8 +66,9 @@ __all__ = [
 # calls safe to replay blind after a reconnect (read-only or naturally
 # idempotent); everything else fails fast to the caller's failover path
 IDEMPOTENT_METHODS = frozenset({
-    "ping", "status", "result", "request_uid", "metrics", "prefix_probe",
-    "lint", "store.get", "store.set", "store.wait"})
+    "ping", "status", "result", "request_uid", "metrics",
+    "metrics_snapshot", "prefix_probe", "lint",
+    "store.get", "store.set", "store.wait"})
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
@@ -184,6 +197,8 @@ class Transport:
     # RequestLog): the plane skips merging shipped worker events then,
     # since the worker already wrote them into the shared log
     shares_process = False
+    # clock-stitching state; concrete carriers replace it per instance
+    stitch: Optional[TransportStitch] = None
 
     def call(self, method: str, payload: Optional[Dict[str, Any]] = None,
              timeout: Optional[float] = None) -> Any:
@@ -195,6 +210,21 @@ class Transport:
     @property
     def alive(self) -> bool:
         return True
+
+    @property
+    def errors(self) -> int:
+        """Failed calls so far (transport loss + remote faults) — the
+        /fleet per-worker transport error count."""
+        m = getattr(self, "_m", None)
+        return int(m.errors.value()) if m is not None else 0
+
+
+def _default_clock_ms() -> float:
+    """The plane/worker default timestamp source for RPC stitching: the
+    process request log's relative clock, so RPC timestamps, request
+    events, and merged timelines share one base per process (and one
+    seam — swapping ``RequestLog._clock`` re-clocks all three)."""
+    return _obs.get_request_log().now_ms()
 
 
 class LoopbackTransport(Transport):
@@ -208,12 +238,20 @@ class LoopbackTransport(Transport):
     shares_process = True
 
     def __init__(self, handler: Callable[[str, Dict[str, Any]], Any],
-                 name: str = "loopback"):
+                 name: str = "loopback",
+                 clock: Optional[Callable[[], float]] = None,
+                 server_clock: Optional[Callable[[], float]] = None):
         self._handler = handler
         self.name = name
         self._dead = False
         self._m = _RpcMetrics(name)
         self._tracer = _obs.get_tracer()
+        # ``clock``/``server_clock`` return ms on the caller's / the
+        # worker's clock; both default to the shared request-log clock
+        # (one process, one clock -> offset ~ 0 by construction)
+        self._clock = clock or _default_clock_ms
+        self._server_clock = server_clock or self._clock
+        self.stitch = TransportStitch(name)
 
     def kill(self) -> None:
         """Simulate worker loss from now on (deterministic)."""
@@ -226,26 +264,35 @@ class LoopbackTransport(Transport):
     def call(self, method: str, payload: Optional[Dict[str, Any]] = None,
              timeout: Optional[float] = None) -> Any:
         self._m.calls.inc()
-        t0 = time.perf_counter()
+        t_wall = time.perf_counter()
         with self._tracer.span("rpc.call", transport=self.name,
                                method=method):
             if self._dead:
                 self._m.errors.inc()
                 raise TransportError(f"{self.name}: worker is gone")
+            t0 = float(self._clock())
             req = encode_message({"method": method,
-                                  "payload": payload or {}})
+                                  "payload": payload or {},
+                                  "ts": {"t0": t0}})
             self._m.bytes_sent.inc(len(req))
             frame = decode_message(req)
+            t1 = float(self._server_clock())
             try:
                 result = self._handler(frame["method"], frame["payload"])
-                resp = encode_message({"ok": True, "result": result})
+                t2 = float(self._server_clock())
+                resp = encode_message({"ok": True, "result": result,
+                                       "ts": {"t1": t1, "t2": t2}})
             except Exception as e:                      # noqa: BLE001
+                t2 = float(self._server_clock())
                 resp = encode_message({"ok": False,
                                        "error": {"kind": type(e).__name__,
-                                                 "msg": str(e)}})
+                                                 "msg": str(e)},
+                                       "ts": {"t1": t1, "t2": t2}})
             self._m.bytes_recv.inc(len(resp))
             out = decode_message(resp)
-        self._m.call_ms.observe((time.perf_counter() - t0) * 1e3)
+            t3 = float(self._clock())
+            self.stitch.record(method, t0, t1, t2, t3)
+        self._m.call_ms.observe((time.perf_counter() - t_wall) * 1e3)
         if not out["ok"]:
             self._m.errors.inc()
             raise RpcError(out["error"]["kind"], out["error"]["msg"])
@@ -262,10 +309,13 @@ class SocketTransport(Transport):
     def __init__(self, host: str, port: int, name: Optional[str] = None,
                  timeout: Optional[float] = None,
                  retries: Optional[int] = None,
-                 backoff: Optional[float] = None):
+                 backoff: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.host = host
         self.port = int(port)
         self.name = name or f"{host}:{port}"
+        self._clock = clock or _default_clock_ms
+        self.stitch = TransportStitch(self.name)
         self._timeout = float(timeout if timeout is not None
                               else _flags.flag("multihost_call_timeout_s"))
         self._retries = int(retries if retries is not None
@@ -312,22 +362,29 @@ class SocketTransport(Transport):
             self._m.errors.inc()
             raise TransportError(f"{self.name}: transport closed")
         tmo = float(timeout if timeout is not None else self._timeout)
-        req = encode_message({"method": method, "payload": payload or {}})
         self._m.calls.inc()
-        self._m.bytes_sent.inc(len(req))
-        t0 = time.perf_counter()
+        t_wall = time.perf_counter()
         with self._lock, self._tracer.span(
                 "rpc.call", transport=self.name, method=method):
             attempts = (self._retries + 1
                         if method in IDEMPOTENT_METHODS else 1)
             last: Optional[Exception] = None
             resp = None
+            t0 = t3 = 0.0
             for attempt in range(attempts):
                 if attempt:
                     self._m.retries.inc()
                     time.sleep(self._backoff * (2 ** (attempt - 1)))
                 try:
+                    # t0 per attempt: the stitch sample must bracket the
+                    # round trip that actually completed
+                    t0 = float(self._clock())
+                    req = encode_message({"method": method,
+                                          "payload": payload or {},
+                                          "ts": {"t0": t0}})
+                    self._m.bytes_sent.inc(len(req))
                     resp = self._roundtrip(req, tmo)
+                    t3 = float(self._clock())
                     break
                 except (OSError, ConnectionError) as e:
                     last = e
@@ -341,8 +398,12 @@ class SocketTransport(Transport):
                 self._m.errors.inc()
                 raise TransportError(f"{self.name}: {method} failed: {last}")
         self._m.bytes_recv.inc(len(resp))
-        self._m.call_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._m.call_ms.observe((time.perf_counter() - t_wall) * 1e3)
         out = decode_message(resp)
+        ts = out.get("ts") or {}
+        if "t1" in ts and "t2" in ts:
+            self.stitch.record(method, t0, float(ts["t1"]),
+                               float(ts["t2"]), t3)
         if not out["ok"]:
             self._m.errors.inc()
             raise RpcError(out["error"]["kind"], out["error"]["msg"])
@@ -368,8 +429,12 @@ class RpcServer:
     connection."""
 
     def __init__(self, handler: Callable[[str, Dict[str, Any]], Any],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 clock: Optional[Callable[[], float]] = None):
         self._handler = handler
+        # server-side stitch clock (ms); workers pass their own clock so
+        # t1/t2 share a base with the request-log events they ship
+        self._clock = clock or _default_clock_ms
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, int(port)))
@@ -404,6 +469,7 @@ class RpcServer:
                     frame = decode_message(read_frame(conn))
                 except (ConnectionError, OSError, ValueError):
                     return
+                t1 = float(self._clock())
                 try:
                     result = self._handler(frame["method"],
                                            frame.get("payload") or {})
@@ -412,6 +478,7 @@ class RpcServer:
                     resp = {"ok": False,
                             "error": {"kind": type(e).__name__,
                                       "msg": str(e)}}
+                resp["ts"] = {"t1": t1, "t2": float(self._clock())}
                 try:
                     write_frame(conn, encode_message(resp))
                 except (ConnectionError, OSError):

@@ -22,6 +22,8 @@ from typing import Any, Dict, List, Set
 import numpy as np
 
 from ... import observability as _obs
+from ...observability.federation import scope_snapshot
+from ...observability.metrics import SNAPSHOT_SCHEMA_VERSION
 from ..engine import SamplingParams, ServingEngine
 
 __all__ = ["EngineWorker"]
@@ -41,6 +43,13 @@ class EngineWorker:
         self._shipped: Dict[int, int] = {}      # uid -> events shipped
         self._closed: Set[int] = set()          # uid left us (exported)
         self.stop_requested = False
+
+    def clock_ms(self) -> float:
+        """The worker clock the RPC server stamps t1/t2 with — the
+        request log's relative clock, so shipped event timestamps and
+        stitch samples share one base (the plane's offset estimate
+        maps both onto the plane clock at once)."""
+        return self._rlog.now_ms()
 
     # -- dispatch ------------------------------------------------------
 
@@ -104,6 +113,7 @@ class EngineWorker:
             cur = self._shipped[uid]
             for ev in tl[cur:]:
                 out.append({"uid": int(uid), "name": ev["name"],
+                            "t_ms": float(ev["t_ms"]),
                             "attrs": _jsonable(ev["attrs"])})
             self._shipped[uid] = len(tl)
             if uid in self._closed or any(
@@ -129,18 +139,40 @@ class EngineWorker:
 
     def _status(self) -> Dict[str, Any]:
         e = self.engine
+        perf = getattr(e, "_perf", None)
+        ratio = getattr(perf, "last_ratio", None) if perf else None
         return {"queue_depth": int(e.queue_depth),
                 "num_active": int(e.num_active),
                 "num_pending": int(e.num_pending),
                 "num_preempted": int(e.num_preempted),
                 "pending_chunks": int(e.pending_chunks),
-                "step_traces": int(e.step_traces)}
+                "step_traces": int(e.step_traces),
+                "num_slots": int(getattr(e, "num_slots", 0) or 0),
+                "engine": str(getattr(e, "_eid", "")),
+                "last_step_ratio": (None if ratio is None
+                                    else round(float(ratio), 6))}
 
     def _rpc_status(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         return self._status()
 
     def _rpc_metrics(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         return _jsonable(self.engine.metrics())
+
+    def _rpc_metrics_snapshot(self, payload: Dict[str, Any]
+                              ) -> Dict[str, Any]:
+        """The PR-4 registry snapshot, scoped to THIS worker's engine
+        series by default (federation correctness: on a loopback plane
+        every worker shares one process registry, so the unscoped
+        snapshot would double-count; ``full=True`` returns it anyway
+        for process-separated debugging)."""
+        snap = _obs.default_registry().snapshot()
+        eid = str(getattr(self.engine, "_eid", ""))
+        if not payload.get("full"):
+            snap = scope_snapshot(snap, eid)
+        return {"schema_version": SNAPSHOT_SCHEMA_VERSION,
+                "worker": self.name, "engine": eid,
+                "clock_ms": float(self.clock_ms()),
+                "snapshot": snap}
 
     def _rpc_prefix_probe(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         warm = 0

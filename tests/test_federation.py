@@ -1,0 +1,357 @@
+"""Federated observability (paddle_tpu/observability/federation.py,
+ISSUE 19).
+
+Three layers, cheapest first:
+
+  * pure math — the NTP-style clock-offset estimator (exact recovery
+    under symmetric RTT, the ±RTT/2 bound under asymmetric RTT,
+    min-RTT sample selection), bucket-pooled percentiles, and the
+    sum-over-sum ratio rule;
+  * FederatedRegistry — worker relabelling, pooled rows, the schema-
+    version gate, and the POST-merge label-cardinality guard (N
+    workers x M label sets coalescing loudly past the cap);
+  * a real 2-worker loopback plane under INJECTED virtual clocks —
+    per-worker skews recovered within the estimator's own error bound,
+    the merged Perfetto timeline structurally complete (plane + worker
+    process tracks, wire/in-worker rpc splits, stitched per-request
+    hops), federated counter totals exactly equal to the process
+    registry, and the fleet-obs signature byte-stable across two
+    identical-seed replays.
+"""
+
+import json
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.observability import federation as fed
+from paddle_tpu.observability.federation import (
+    ClockOffsetEstimator, FederatedRegistry, TransportStitch,
+    percentile_from_buckets, scope_snapshot)
+from paddle_tpu.observability.metrics import SNAPSHOT_SCHEMA_VERSION
+from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.multihost import (EngineWorker, LoopbackTransport,
+                                          MultiHostRouter)
+
+
+# -- clock-offset estimator (pure math) -----------------------------------
+
+def test_offset_exact_recovery_positive_and_negative_skew():
+    # symmetric wire delay: the NTP estimate is EXACT for any skew sign
+    for skew in (37.0, -53.0, 0.0):
+        est = ClockOffsetEstimator()
+        t0 = 100.0
+        t1 = t0 + 2.0 + skew          # 2 ms out, server clock leads
+        t2 = t1 + 1.0                 # 1 ms in-worker
+        t3 = t0 + 2.0 + 1.0 + 2.0     # 2 ms back
+        est.add_sample(t0, t1, t2, t3)
+        assert est.ready
+        assert est.offset_ms == pytest.approx(skew)
+        assert est.min_rtt_ms == pytest.approx(4.0)
+        assert est.error_bound_ms == pytest.approx(2.0)
+        # remote -> local mapping inverts the skew
+        assert est.to_local_ms(t1) == pytest.approx(t0 + 2.0)
+
+
+def test_offset_error_within_bound_under_asymmetric_rtt():
+    # 8 ms out / 2 ms back: the single-sample estimate is wrong by the
+    # delay asymmetry /2 = 3 ms, which the +-RTT/2 bound must cover
+    skew = 11.0
+    est = ClockOffsetEstimator()
+    t0 = 50.0
+    t1 = t0 + 8.0 + skew
+    t2 = t1 + 1.0
+    t3 = t0 + 8.0 + 1.0 + 2.0
+    est.add_sample(t0, t1, t2, t3)
+    err = abs(est.offset_ms - skew)
+    assert err == pytest.approx(3.0)
+    assert est.min_rtt_ms == pytest.approx(10.0)
+    assert err <= est.error_bound_ms
+
+
+def test_offset_keeps_min_rtt_sample_first_wins_ties():
+    est = ClockOffsetEstimator()
+    # noisy sample: rtt 20, estimate off by 5
+    est.add_sample(0.0, 15.0, 16.0, 20.0)
+    noisy = est.offset_ms
+    # tight symmetric sample (0.5 ms each way, 1 ms in-worker): exact
+    est.add_sample(100.0, 100.5 + 7.0, 101.5 + 7.0, 102.0)
+    assert est.offset_ms == pytest.approx(7.0)
+    assert est.offset_ms != noisy
+    # equal-RTT sample with a different estimate must NOT displace the
+    # incumbent (first-wins ties keep replays deterministic)
+    est.add_sample(200.0, 200.5 + 9.0, 201.5 + 9.0, 202.0)
+    assert est.offset_ms == pytest.approx(7.0)
+    assert est.samples == 3
+
+
+def test_transport_stitch_bounds_records_counts_drops(monkeypatch):
+    monkeypatch.setattr(TransportStitch, "MAX_RECORDS", 3)
+    st = TransportStitch("w0")
+    for i in range(5):
+        st.record("step", i, i + 1.0, i + 1.5, i + 2.0)
+    assert len(st.records) == 3 and st.dropped == 2
+    # every sample still feeds the estimator, only the slice record is
+    # bounded
+    assert st.estimator.samples == 5
+
+
+# -- pooled-percentile math -----------------------------------------------
+
+def test_percentile_from_buckets_interpolation_and_inf_clamp():
+    buckets = {"1": 2, "5": 6, "10": 9, "+Inf": 10}
+    # p50 -> rank 5, inside (1, 5] which holds counts 3..6:
+    # 1 + 4 * (5 - 2) / 4 = 4.0
+    assert percentile_from_buckets(buckets, 0.5) == pytest.approx(4.0)
+    # p100 lands in +Inf: clamps to the largest finite bound
+    assert percentile_from_buckets(buckets, 1.0) == pytest.approx(10.0)
+    assert percentile_from_buckets({"+Inf": 0}, 0.5) is None
+    with pytest.raises(ValueError):
+        percentile_from_buckets(buckets, 1.5)
+
+
+# -- the federated registry -----------------------------------------------
+
+def _snap(worker_families):
+    out = {"schema_version": SNAPSHOT_SCHEMA_VERSION}
+    out.update(worker_families)
+    return out
+
+
+def test_federated_merge_worker_labels_and_pooled_counter_sum():
+    reg = FederatedRegistry()
+    reg.add_snapshot("w0", _snap({"serving.requests": {
+        "type": "counter", "help": "h",
+        "series": [{"labels": {"tenant": "a"}, "value": 3.0},
+                   {"labels": {"tenant": "b"}, "value": 1.0}]}}))
+    reg.add_snapshot("w1", _snap({"serving.requests": {
+        "type": "counter", "help": "h",
+        "series": [{"labels": {"tenant": "a"}, "value": 5.0}]}}))
+    fam = reg.merged()["serving.requests"]
+    got = {tuple(sorted(r["labels"].items())): r["value"]
+           for r in fam["series"]}
+    assert got == {(("tenant", "a"), ("worker", "w0")): 3.0,
+                   (("tenant", "b"), ("worker", "w0")): 1.0,
+                   (("tenant", "a"), ("worker", "w1")): 5.0}
+    assert fam["pooled"]["value"] == 9.0
+    assert reg.family_total("serving.requests") == 9.0
+
+
+def test_federated_pooled_histogram_recomputes_from_summed_buckets():
+    rows = {
+        "w0": {"labels": {}, "count": 4, "sum": 10.0,
+               "buckets": {"1": 2, "5": 4, "+Inf": 4}},
+        "w1": {"labels": {}, "count": 6, "sum": 40.0,
+               "buckets": {"1": 0, "5": 2, "+Inf": 6}},
+    }
+    reg = FederatedRegistry()
+    for w, row in rows.items():
+        reg.add_snapshot(w, _snap({"lat": {
+            "type": "histogram", "help": "h", "series": [row]}}))
+    fam = reg.merged()["lat"]
+    assert fam["pooled"]["count"] == 10
+    assert fam["pooled"]["sum"] == pytest.approx(50.0)
+    assert fam["pooled"]["buckets"] == {"1": 2, "5": 6, "+Inf": 10}
+    # the pooled quantile is read from MERGED buckets — identical to
+    # recomputing by hand, never an average of per-worker quantiles
+    assert reg.pooled_percentile("lat", 0.5) == pytest.approx(
+        percentile_from_buckets({"1": 2, "5": 6, "+Inf": 10}, 0.5))
+
+
+def test_federated_ratio_sums_before_dividing():
+    reg = FederatedRegistry()
+    # w0: 9/10 hit rate on heavy traffic; w1: 0/1 on a single miss.
+    # sum-over-sum = 9/11; the per-worker-ratio average (0.45) is the
+    # statistical bug the BASELINE rule exists to prevent
+    reg.add_snapshot("w0", _snap({
+        "hits": {"type": "counter", "help": "",
+                 "series": [{"labels": {}, "value": 9.0}]},
+        "lookups": {"type": "counter", "help": "",
+                    "series": [{"labels": {}, "value": 10.0}]}}))
+    reg.add_snapshot("w1", _snap({
+        "hits": {"type": "counter", "help": "",
+                 "series": [{"labels": {}, "value": 0.0}]},
+        "lookups": {"type": "counter", "help": "",
+                    "series": [{"labels": {}, "value": 1.0}]}}))
+    assert reg.pooled_ratio("hits", "lookups") == pytest.approx(9 / 11)
+
+
+def test_federated_schema_version_mismatch_refused():
+    reg = FederatedRegistry()
+    with pytest.raises(ValueError, match="schema_version"):
+        reg.add_snapshot("w0", {"schema_version": -1})
+
+
+def test_post_merge_cardinality_guard_coalesces_loudly():
+    """The regression test for the POST-merge guard: per-worker
+    snapshots each inside the cap can still overflow once N workers x
+    M label sets federate."""
+    reg = FederatedRegistry(max_children=4)
+    for w in ("w0", "w1", "w2"):
+        reg.add_snapshot(w, _snap({"reqs": {
+            "type": "counter", "help": "h",
+            "series": [{"labels": {"tenant": str(t)}, "value": 1.0}
+                       for t in range(2)]}}))
+    with pytest.warns(RuntimeWarning, match="post-merge cardinality"):
+        fam = reg.merged()
+    fam = fam["reqs"]
+    assert fam["coalesced"] == 2
+    assert len(fam["series"]) == 5         # cap + the overflow child
+    spill = [r for r in fam["series"]
+             if r["labels"].get("overflow") == "true"]
+    assert len(spill) == 1 and spill[0]["value"] == 2.0
+    # nothing lost: pooled total still covers every child
+    assert fam["pooled"]["value"] == 6.0
+    # the warning fires once per family, not once per scrape
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        reg.merged()
+
+
+# -- real 2-worker loopback plane under injected clocks -------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    pt.seed(0)
+    return LlamaForCausalLM(tiny_llama_config())
+
+
+_SKEWS = {"w0": 37.0, "w1": -53.0}
+
+
+def _fleet_run(model):
+    """One seeded trace through a 2-worker loopback plane with ALL
+    clocks virtual: the request log (and each engine) reads a counter
+    advancing 0.1 ms per read, each worker's server clock runs at a
+    fixed injected skew.  Returns everything the structural and
+    determinism assertions need."""
+    log = obs.get_request_log()
+    saved_clock, saved_t0 = log._clock, log._t0
+    cell = {"t": 0.0}
+
+    def vclock():
+        cell["t"] += 1e-4
+        return cell["t"]
+
+    log._clock, log._t0 = vclock, 0.0
+    try:
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(3, 90, size=n).tolist()
+                   for n in (7, 12, 9)]
+        workers = OrderedDict()
+        for i in range(2):
+            nm = f"w{i}"
+            eng = ServingEngine(model, num_slots=4, max_length=128,
+                                prefill_batch=2, paged=True, block_len=8)
+            eng._clock = vclock
+            w = EngineWorker(eng, name=nm)
+            workers[nm] = LoopbackTransport(
+                w.handle, name=nm,
+                server_clock=(lambda s=_SKEWS[nm]: log.now_ms() + s))
+        plane = MultiHostRouter(workers, policy="prefix")
+        mark = log.mark()
+        rids = [plane.submit(p, max_new_tokens=6) for p in prompts]
+        out = dict(plane.drain())
+        end = log.mark()
+        # everything registry-derived is captured HERE: the autouse
+        # _observability_guard resets the process registry before each
+        # test, so by assertion time only this stash survives
+        eids = {str(t.call("metrics_snapshot", {})["engine"])
+                for t in plane._workers.values()}
+        return {"plane": plane,
+                "tokens": [out[r] for r in rids],
+                "trace": plane.export_merged_perfetto(
+                    since_uid=mark, until_uid=end),
+                "sig": plane.fleet_obs_signature(
+                    since_uid=mark, until_uid=end),
+                "merged": plane.federation().merged(),
+                "snap": obs.snapshot(),
+                "eids": eids}
+    finally:
+        log._clock, log._t0 = saved_clock, saved_t0
+
+
+@pytest.fixture(scope="module")
+def fleet_runs(tiny_model):
+    obs.reset()                            # the test-isolation hook
+    return _fleet_run(tiny_model), _fleet_run(tiny_model)
+
+
+def test_injected_skews_recovered_within_bound(fleet_runs):
+    plane = fleet_runs[0]["plane"]
+    for nm, t in plane._workers.items():
+        est = t.stitch.estimator
+        assert est.ready
+        assert abs(est.offset_ms - _SKEWS[nm]) <= est.error_bound_ms
+
+
+def test_merged_perfetto_structure(fleet_runs):
+    trace = fleet_runs[0]["trace"]
+    evs = trace["traceEvents"]
+    procs = {e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert {"paddle_tpu plane", "paddle_tpu requests",
+            "paddle_tpu worker w0", "paddle_tpu worker w1"} <= procs
+    # every rpc.call slice splits into wire + in_worker children whose
+    # durations add back up to the parent
+    calls = [e for e in evs
+             if str(e.get("name", "")).startswith("rpc.call:")]
+    assert calls
+    for c in calls:
+        assert c["args"]["wire_ms"] + c["args"]["in_worker_ms"] == \
+            pytest.approx(c["dur"] / 1e3)
+    assert any(e.get("name") == "in_worker" for e in evs)
+    # worker-side handler slices live on the worker process tracks
+    assert any(str(e.get("name", "")).startswith("worker.handle:")
+               for e in evs)
+    # per-request tracks carry stitched placement hops
+    assert any(str(e.get("name", "")).startswith("on w")
+               and e.get("ph") == "X" for e in evs)
+
+
+def test_federated_counters_equal_process_registry(fleet_runs):
+    """The loopback double-count proof: each worker's metrics_snapshot
+    is engine-scoped, so federated pooled counters equal the process
+    registry totals exactly (not N x them)."""
+    run = fleet_runs[1]
+    merged, snap, eids = run["merged"], run["snap"], run["eids"]
+    checked = 0
+    for name, fam in merged.items():
+        if name in ("schema_version", "workers") \
+                or fam["type"] != "counter":
+            continue
+        direct = sum(
+            float(r["value"]) for r in snap[name]["series"]
+            if str(r["labels"].get("engine", "")) in eids)
+        assert fam["pooled"]["value"] == pytest.approx(direct), name
+        checked += 1
+    assert checked > 0
+
+
+def test_fleet_obs_signature_byte_stable_across_replays(fleet_runs):
+    a, b = fleet_runs
+    assert a["tokens"] == b["tokens"]
+    assert a["sig"] == b["sig"]
+    # the canonical (uid-normalised) merged timelines are BYTE-equal,
+    # not merely hash-equal
+    ca = json.dumps(fed._canonical_trace(a["trace"]), sort_keys=True)
+    cb = json.dumps(fed._canonical_trace(b["trace"]), sort_keys=True)
+    assert ca == cb
+
+
+def test_scope_snapshot_filters_by_engine_label():
+    snap = {"schema_version": SNAPSHOT_SCHEMA_VERSION,
+            "c": {"type": "counter", "help": "",
+                  "series": [{"labels": {"engine": "1"}, "value": 2.0},
+                             {"labels": {"engine": "2"}, "value": 5.0}]},
+            "global": {"type": "counter", "help": "",
+                       "series": [{"labels": {}, "value": 1.0}]}}
+    scoped = scope_snapshot(snap, "1")
+    assert [r["value"] for r in scoped["c"]["series"]] == [2.0]
+    # process-wide families without an engine label stay plane-side
+    assert "global" not in scoped

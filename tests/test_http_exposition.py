@@ -52,7 +52,7 @@ def test_metrics_healthz_requests_and_404_over_http():
 
         code, _, body = _get(srv.port, "/requests?n=4")
         tail = json.loads(body)
-        assert set(tail) == {"requests", "total"}
+        assert set(tail) == {"requests", "total", "limit"}
 
         try:
             _get(srv.port, "/no/such/path")

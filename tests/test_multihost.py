@@ -194,7 +194,10 @@ def test_worker_loss_failover_keeps_one_timeline(tiny_model, prompts,
     """Kill a worker mid-decode: every request still completes with the
     reference tokens (recompute-from-prefix re-admission on survivors)
     and the lifecycle stays ONE record per request_uid — submitted
-    once, worker_lost -> failover -> placed in order."""
+    once, worker_lost -> failover -> placed in order.  The fleet-health
+    metrics (ISSUE 19) must classify the loss: one worker_lost increment
+    with a reason label on the victim, and a live tick-accurate
+    heartbeat-age gauge on the survivor only."""
     plane = _mk_plane(tiny_model)
     rids = [plane.submit(p, max_new_tokens=8) for p in prompts]
     for _ in range(4):
@@ -207,6 +210,30 @@ def test_worker_loss_failover_keeps_one_timeline(tiny_model, prompts,
     assert list(plane.lost_workers) == [victim]
     agg = plane.metrics()["aggregate"]
     assert agg["failovers"] >= 1
+    snap = obs.snapshot()
+    lost = [r for r in snap["plane.worker_lost"]["series"]
+            if r["labels"].get("plane") == plane._pid]
+    assert len(lost) == 1 and lost[0]["value"] == 1
+    assert lost[0]["labels"]["worker"] == victim
+    # a killed loopback peer surfaces as a TransportError on the next
+    # call — whichever of heartbeat/step hits it first, the reason
+    # label lands in the fixed two-value vocabulary
+    assert lost[0]["labels"]["reason"] in ("missed_heartbeat",
+                                           "transport_error")
+    ages = {r["labels"]["worker"]: r["value"]
+            for r in snap["plane.heartbeat_age_ticks"]["series"]
+            if r["labels"].get("plane") == plane._pid}
+    survivor = next(n for n in ("w0", "w1") if n != victim)
+    # the gauge tracks LIVE workers only: the victim's series froze at
+    # its pre-kill value, the survivor's stays inside one heartbeat
+    # interval of the current tick
+    age = ages[survivor]
+    assert 0 <= age <= plane._hb_every
+    fleet = plane.fleet_report()["workers"]
+    assert fleet[survivor]["alive"] is True
+    assert fleet[survivor]["heartbeat_age_ticks"] == age
+    assert fleet[victim]["alive"] is False
+    assert fleet[victim]["heartbeat_age_ticks"] is None
     log = obs.get_request_log()
     saw_failover = False
     for rid in rids:
