@@ -1332,6 +1332,178 @@ def _spec_decode_bench(model, on_tpu):
                          "no TPU device in this environment"}}
 
 
+def _spec_model_bench(model, on_tpu):
+    """Draft-MODEL vs n-gram drafter A/B (ISSUE 20): the same traces
+    through two spec engines that differ only in their drafter —
+    prompt-lookup n-gram vs a truncated-target draft model
+    (``draft_model_from``, rejection-sampling acceptance) — on
+
+      * a **novel-text** trace (permutation prompts: no n-gram ever
+        recurs, so prompt-lookup STARVES — the draft model must beat it
+        on accepted/step here, the headline gate), and
+      * the **PR-7 repetition trace** (motif-tiled prompts, where
+        prompt-lookup is strongest — the draft model only has to stay
+        competitive, not win).
+
+    Each arm reports accepted/step, hit rate, and the **draft-step
+    overhead fraction** (host wall spent proposing / total wall — the
+    cost side of the speculation trade; BASELINE.md excludes draft
+    FLOPs from every tok/s numerator).  The mesh rows record the
+    flash-decode dispatch decision for this engine's shapes under
+    mp2dp2 — the verify window must choose ``pallas_decode_shard_map``
+    (ISSUE 20 tentpole b).  CPU = plumbing smoke; the tok/s claim is
+    the pending TPU re-check."""
+    import numpy as np
+
+    from paddle_tpu.models import draft_model_from
+    from paddle_tpu.serving import ServingEngine
+
+    if on_tpu:
+        slots, max_len, spec_k, n_req = 8, 2048, 4, 24
+        motif_len, reps, nnew = 16, 12, 96
+        plo, phi = 64, 192
+        draft_layers = 4
+    else:  # plumbing smoke: tiny trace, no perf meaning
+        slots, max_len, spec_k, n_req = 4, 128, 3, 8
+        motif_len, reps, nnew = 4, 6, 24
+        plo, phi = 12, 24
+        draft_layers = 1
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(0)
+    # the PR-7 repetition trace: motif-tiled prompts, unique heads
+    rep_prompts = [
+        np.concatenate([rng.randint(0, vocab, 2).astype(np.int32),
+                        np.tile(rng.randint(0, vocab, motif_len)
+                                .astype(np.int32), reps)])
+        for _ in range(n_req)]
+    # novel-text: permutations — every token once, nothing for the
+    # n-gram drafter to match (the paper's case for a learned drafter)
+    rng = np.random.RandomState(20)
+    novel_prompts = [
+        rng.permutation(vocab)[:rng.randint(plo, phi + 1)]
+        .astype(np.int32) for _ in range(n_req)]
+    dm, dparams = draft_model_from(model, num_layers=draft_layers)
+
+    def run(eng, prompts):
+        rids = [eng.submit(p, max_new_tokens=nnew) for p in prompts]
+        ticks = 0
+        while eng.num_active or eng.queue_depth or eng.num_pending:
+            eng.step()
+            ticks += 1
+        return [eng.result(r) for r in rids], ticks
+
+    def arm(drafter_kw, label, prompts):
+        eng = ServingEngine(model, num_slots=slots, max_length=max_len,
+                            spec_decode=True, spec_k=spec_k, **drafter_kw)
+        out_warm, _ = run(eng, prompts)             # compile + warm
+        # time the drafter's host-side proposal work on the timed pass
+        d = eng._drafter
+        spent = [0.0]
+        attr = "propose_batch" if getattr(d, "uses_device", False) \
+            else "propose"
+        orig = getattr(d, attr)
+
+        def timed(*a, **kw):
+            t0 = time.perf_counter()
+            r = orig(*a, **kw)
+            spent[0] += time.perf_counter() - t0
+            return r
+        setattr(d, attr, timed)
+        t0 = time.perf_counter()
+        out, ticks = run(eng, prompts)
+        t = time.perf_counter() - t0
+        setattr(d, attr, orig)
+        sm = eng.metrics()["spec"]
+        row = {"drafter": label, "ticks": ticks,
+               "tokens_per_sec": round(
+                   sum(len(o) for o in out) / t, 1),
+               "accepted_per_step": sm["accepted_per_step"],
+               "draft_hit_rate": sm["draft_hit_rate"],
+               "drafted_tokens_2pass": sm["drafted_tokens"],
+               "rollbacks_2pass": sm["rollbacks"],
+               "draft_overhead_frac": round(spent[0] / t, 3),
+               "step_traces": eng.step_traces,
+               # greedy replay: pass 2 must re-commit pass 1's tokens
+               "deterministic_replay": out == out_warm}
+        if getattr(d, "uses_device", False):
+            row["draft_step_traces"] = d.draft_traces
+        return eng, out, row
+
+    def ab(prompts, tag):
+        _, out_n, row_n = arm({"drafter": "ngram"}, "ngram", prompts)
+        eng_m, out_m, row_m = arm(
+            {"drafter": "model", "draft_model": (dm, dparams)},
+            "model", prompts)
+        return eng_m, {"trace": tag, "ngram": row_n, "model": row_m,
+                       "greedy_parity": out_n == out_m}
+
+    eng_m, novel = ab(novel_prompts, "novel-text (permutation prompts)")
+    _, rep = ab(rep_prompts, "repetition-heavy (PR-7 motif trace)")
+    lint_findings = len(eng_m.lint_step())
+
+    # mesh dispatch rows: the decision the mp2dp2 engine's trace makes
+    # for this engine's decode shapes (needs >= 4 devices; static)
+    mesh_paths = []
+    import jax
+    if jax.device_count() >= 4:
+        from paddle_tpu import flags as _flags
+        from paddle_tpu.distributed import env as _denv
+        from paddle_tpu.ops.attention import (decode_attention_path,
+                                              reason_kind)
+        c = model.config
+        hq, hkv = int(c.num_attention_heads), int(c.num_key_value_heads)
+        hd = int(c.head_dim)
+        old = _flags.flag("pallas_interpret")
+        _flags.set_flags({"pallas_interpret": True})
+        try:
+            mesh = ServingEngine._resolve_mesh("mp2dp2")
+            with _denv.use_mesh(mesh):
+                for b, s, what in ((slots, spec_k + 1, "spec_verify"),
+                                   (slots, 1, "decode"),
+                                   (1, 1, "decode_b1")):
+                    path, why = decode_attention_path(b, s, hq, hkv,
+                                                      hd, 8192)
+                    row = {"what": what, "b": b, "s": s,
+                           "chosen_path": path}
+                    if why is not None:
+                        row["fallback_reason"] = str(why)
+                        row["reason_kind"] = reason_kind(why)
+                    mesh_paths.append(row)
+        finally:
+            _flags.set_flags({"pallas_interpret": old})
+
+    novel_win = (novel["model"]["accepted_per_step"].get("mean", 0)
+                 or 0) > (novel["ngram"]["accepted_per_step"]
+                          .get("mean", 0) or 0)
+    return {"spec_k": spec_k, "num_slots": slots, "max_length": max_len,
+            "draft_layers": draft_layers,
+            "novel_text": novel, "repetition_heavy": rep,
+            "model_beats_ngram_on_novel": bool(novel_win),
+            "deterministic_replay": bool(
+                novel["model"]["deterministic_replay"]
+                and novel["ngram"]["deterministic_replay"]
+                and rep["model"]["deterministic_replay"]
+                and rep["ngram"]["deterministic_replay"]),
+            "lint_findings": lint_findings,
+            "mesh_paths": mesh_paths,
+            "note": "same trace through an n-gram-drafted and a "
+                    "draft-model spec engine; tok/s counts committed "
+                    "tokens only and EXCLUDES draft FLOPs from the "
+                    "numerator (BASELINE.md rejection-sampling "
+                    "conventions); draft_overhead_frac is the cost "
+                    "side.  On CPU the win shows in accepted/step and "
+                    "ticks; the tok/s multiple at the weight-stream "
+                    "bound is the pending re-check",
+            "tpu_recheck": {
+                "status": "pending_tpu",
+                "command": "bench.py --sections spec_model",
+                "claim": "accepted_per_step(model) > 1 on novel text "
+                         "where n-gram sits at 1.0, at a draft-step "
+                         "overhead small enough (truncated-layer draft "
+                         "reusing target weights) that committed tok/s "
+                         "rises; no TPU device in this environment"}}
+
+
 def _mesh_serving_bench(model, on_tpu):
     """Mesh-sharded serving A/B (ISSUE 9), two halves:
 
@@ -2781,10 +2953,12 @@ def run_decode_bench(args):
     """bench.py --decode → BENCH_DECODE.json + one JSON line."""
     import faulthandler
     faulthandler.dump_traceback_later(1200, exit=False)  # hang diagnostics
-    if "mesh_serving" in (args.sections or ""):
-        # the mp2dp2 engine A/B needs >= 4 devices; on the CPU smoke
-        # host fake them the way tests/conftest.py does (must precede
-        # the first jax backend initialisation below)
+    if ("mesh_serving" in (args.sections or "")
+            or "spec_model" in (args.sections or "")):
+        # the mp2dp2 engine A/B (and spec_model's mesh dispatch rows)
+        # need >= 4 devices; on the CPU smoke host fake them the way
+        # tests/conftest.py does (must precede the first jax backend
+        # initialisation below)
         if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "")
@@ -2823,9 +2997,10 @@ def run_decode_bench(args):
     model = params = None
     n = pbytes = 0
     if want & {"prefill", "decode", "int8", "e2e", "serving",
-               "spec_decode", "mesh_serving", "slo_serving",
-               "int8_serving", "perf_model", "preempt_serving",
-               "control_plane", "disagg_serving", "multihost_obs"}:
+               "spec_decode", "spec_model", "mesh_serving",
+               "slo_serving", "int8_serving", "perf_model",
+               "preempt_serving", "control_plane", "disagg_serving",
+               "multihost_obs"}:
         model, params, n = _decode_model(max_pos=8192 if on_tpu else 512,
                                          on_tpu=on_tpu)
         pbytes = n * 2                                  # bf16 weights
@@ -3016,6 +3191,23 @@ def run_decode_bench(args):
               f"{rh['accepted_per_step'].get('mean')}, hit_rate "
               f"{rh['draft_hit_rate']}, parity {rh['greedy_parity']} / "
               f"{sp['adversarial']['greedy_parity']}", file=sys.stderr)
+
+    # -- draft-model vs n-gram drafter A/B -------------------------------
+    if "spec_model" in want:
+        print("[decode-bench] spec-model drafter A/B trace ...",
+              file=sys.stderr)
+        sm = _spec_model_bench(model, on_tpu)
+        _merge_decode_artifact(skey, {"spec_model": sm})
+        nv = sm["novel_text"]
+        print(f"spec_model: novel-text accepted/step model "
+              f"{nv['model']['accepted_per_step'].get('mean')} vs ngram "
+              f"{nv['ngram']['accepted_per_step'].get('mean')} "
+              f"(win={sm['model_beats_ngram_on_novel']}), parity "
+              f"{nv['greedy_parity']} / "
+              f"{sm['repetition_heavy']['greedy_parity']}, draft "
+              f"overhead {nv['model']['draft_overhead_frac']}, mesh "
+              f"paths {[r['chosen_path'] for r in sm['mesh_paths']]}",
+              file=sys.stderr)
 
     # -- int8 quantized KV-cache serving A/B/C ---------------------------
     if "int8_serving" in want:
@@ -3266,6 +3458,9 @@ def main():
                          "prefill,decode,int8,e2e,fused (default all) "
                          "plus the opt-in continuous-batching 'serving' "
                          "trace, the 'spec_decode' speculative A/B, "
+                         "the 'spec_model' draft-model-vs-n-gram "
+                         "drafter A/B (novel-text + repetition traces, "
+                         "rejection sampling, mesh dispatch rows) and "
                          "the 'mesh_serving' mp-engine + dp-router A/B "
                          "(needs 4+ devices; the CPU smoke fakes 8) and "
                          "the 'slo_serving' goodput-under-SLO wave-vs-"

@@ -226,6 +226,15 @@ DEFINE("serving_spec_ngram", 3,
        "longest n-gram the prompt-lookup self-drafter matches against "
        "each slot's prompt+generated history when proposing drafts "
        "(it backs off to shorter n-grams, floor 1, before giving up)")
+DEFINE("serving_spec_drafter", "ngram",
+       "ServingEngine default drafter kind: 'ngram' = the free host-side "
+       "prompt-lookup proposer (serving/drafter.py NgramDrafter); "
+       "'model' = a draft MODEL sharing the engine (its own param set, "
+       "tiny contiguous KV cache and once-jitted draft step at q-depth "
+       "k), which drafts novel text the n-gram matcher cannot and "
+       "emits the proposal distribution the rejection-sampling "
+       "acceptance needs.  Engine constructor arg and per-request "
+       "submit(drafter=...) override")
 # mesh-sharded serving (serving/engine.py mesh=... + serving/router.py):
 # the tensor-parallel engine step and the data-parallel replica router —
 # ROADMAP item 1's multi-chip execution path

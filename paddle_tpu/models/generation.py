@@ -109,8 +109,37 @@ def sample_tokens(logits, key, temperature=0.0, top_k=None, top_p=None):
     return jnp.where(temperature <= 0.0, greedy, samp)
 
 
+def _target_probs(logits, temperature, top_k=None, top_p=None):
+    """The target distribution :func:`sample_tokens` samples from, as
+    explicit per-token probabilities — the p(x) of the rejection-sampling
+    acceptance rule (Leviathan et al. 2023).  Applies EXACTLY the same
+    transforms as ``sample_tokens``' traced branch (fp32 cast,
+    clamped-temperature scaling, per-row dynamic top-k, nucleus mask)
+    and then normalises, so accept/resample decisions are made against
+    the same distribution the plain step would sample.
+
+    ``logits``: (B, S, V); knobs: (B,) vectors (or static scalars,
+    broadcast).  Returns f32 (B, S, V) rows summing to 1."""
+    logits = logits.astype(jnp.float32)
+    b = logits.shape[0]
+    vocab = logits.shape[-1]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    scaled = logits / jnp.maximum(t, 1e-6)[:, None, None]
+    if top_k is not None:
+        tk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+        srt = jnp.sort(scaled, axis=-1)[..., ::-1]
+        k_eff = jnp.where(tk > 0, jnp.clip(tk, 1, vocab), vocab)
+        kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None, None], axis=-1)
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None:
+        tp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+        scaled = _nucleus_mask(scaled, tp[:, None, None])
+    return jax.nn.softmax(scaled, axis=-1)
+
+
 def accept_draft_tokens(logits, drafts, draft_mask, key, temperature=0.0,
-                        top_k=None, top_p=None, pad_token_id: int = 0):
+                        top_k=None, top_p=None, pad_token_id: int = 0,
+                        draft_probs=None):
     """Accept-longest-prefix verification for speculative decoding — the
     in-graph half of the serving engine's spec-decode step (the drafter
     lives on the host: serving/drafter.py).
@@ -128,9 +157,24 @@ def accept_draft_tokens(logits, drafts, draft_mask, key, temperature=0.0,
     Acceptance policy: **greedy rows** (``temperature <= 0``) match
     against the argmax, so the committed stream is token-identical to
     plain one-token-per-step greedy decode (the exact-parity case of
-    Leviathan et al. 2023).  **Sampled rows** accept only position 0 —
-    plain decode behaviour, keeping the sampling distribution exact
-    instead of approximating rejection sampling.
+    Leviathan et al. 2023).  **Sampled rows** depend on ``draft_probs``:
+
+      * ``draft_probs=None`` (legacy): accept only position 0 — plain
+        decode behaviour, no approximation;
+      * ``draft_probs`` given — f32 (B, S-1, V), the drafter's proposal
+        distribution q per drafted column — full **rejection sampling**:
+        draft ``d_j`` is accepted w.p. ``min(1, p(d_j)/q(d_j))`` against
+        the target p from :func:`_target_probs`; the first rejected
+        column commits a resample from the normalised residual
+        ``max(0, p - q)`` instead, and a fully-verified row commits a
+        bonus token sampled from the last position's target.  The
+        committed stream is distributed EXACTLY as plain sampling
+        (Leviathan et al. 2023, Thm 1).  Convention: a column the
+        drafter skipped carries an all-zero q row (and
+        ``draft_mask=False``), making its residual the plain target —
+        the first non-drafted column is an ordinary sample.  One-hot q
+        rows express a deterministic proposer (the n-gram drafter):
+        accept w.p. min(1, p(d)), residual = p with d removed.
 
     ``logits``: (B, S, V); ``drafts``: int (B, S-1); ``draft_mask``:
     bool (B, S-1), True where the column holds a real proposal (pad
@@ -147,17 +191,57 @@ def accept_draft_tokens(logits, drafts, draft_mask, key, temperature=0.0,
     if s == 1:
         return out, jnp.ones((b,), jnp.int32)
     match = (out[:, :-1] == drafts) & draft_mask           # (B, S-1)
-    if isinstance(temperature, (int, float)):
-        if temperature > 0.0:
-            match = jnp.zeros_like(match)
-    else:
-        match = match & (temperature <= 0.0)[:, None]
-    # longest verified prefix: cumprod zeroes everything past the first
-    # mismatch; +1 is the bonus token the last verified position earned
-    n = (1 + jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+    if draft_probs is None:
+        if isinstance(temperature, (int, float)):
+            if temperature > 0.0:
+                match = jnp.zeros_like(match)
+        else:
+            match = match & (temperature <= 0.0)[:, None]
+        # longest verified prefix: cumprod zeroes everything past the
+        # first mismatch; +1 is the bonus token the last verified
+        # position earned
+        n = (1 + jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                         axis=1)).astype(jnp.int32)
+        keep = jnp.arange(s)[None, :] < n[:, None]
+        return jnp.where(keep, out, jnp.int32(pad_token_id)), n
+    # rejection sampling: greedy rows keep the exact argmax-match rule
+    # (token-identical to plain greedy decode); sampled rows accept
+    # d_j w.p. min(1, p/q) — u < p/q  ⇔  u·q < p with u ~ U[0, 1)
+    greedy_row = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32) <= 0.0, (b,))    # (B,)
+    p = _target_probs(logits[:, :-1], temperature, top_k, top_p)
+    q = jnp.asarray(draft_probs, jnp.float32)              # (B, S-1, V)
+    d = drafts.astype(jnp.int32)[..., None]
+    p_d = jnp.take_along_axis(p, d, axis=-1)[..., 0]       # (B, S-1)
+    q_d = jnp.take_along_axis(q, d, axis=-1)[..., 0]
+    u = jax.random.uniform(jax.random.fold_in(key, 0x5eed), (b, s - 1))
+    acc = jnp.where(greedy_row[:, None], match,
+                    (u * q_d < p_d) & draft_mask)
+    n = (1 + jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
                      axis=1)).astype(jnp.int32)
-    keep = jnp.arange(s)[None, :] < n[:, None]
-    return jnp.where(keep, out, jnp.int32(pad_token_id)), n
+    # residual resample for the first rejected column; a zero-mass
+    # residual (q == p pointwise, or an all-zero pad-column q) falls
+    # back to the plain target — both limits are exact
+    res = jnp.maximum(p - q, 0.0)
+    mass = jnp.sum(res, axis=-1, keepdims=True)
+    res = jnp.where(mass > 1e-9, res, p)
+    resampled = jax.random.categorical(
+        jax.random.fold_in(key, 0x7e5a),
+        jnp.log(res + 1e-30), axis=-1).astype(jnp.int32)   # (B, S-1)
+    # committed row: accepted drafts verbatim, then ONE fresh token at
+    # column n-1 (residual resample, or the bonus sample when every
+    # draft survived), pad after.  Greedy rows take the legacy ``out``
+    # columns — identical tokens by the match rule.
+    cand = jnp.concatenate([resampled, out[:, -1:]], axis=1)   # (B, S)
+    cand = jnp.where(greedy_row[:, None], out, cand)
+    drafts_pad = jnp.concatenate(
+        [drafts.astype(jnp.int32),
+         jnp.full((b, 1), pad_token_id, jnp.int32)], axis=1)
+    col = jnp.arange(s)[None, :]
+    toks = jnp.where(col < (n - 1)[:, None], drafts_pad,
+                     jnp.where(col == (n - 1)[:, None], cand,
+                               jnp.int32(pad_token_id)))
+    return toks, n
 
 
 def decode_mesh_specs(model, params, axis_names, paged_cache=False,

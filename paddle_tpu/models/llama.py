@@ -601,6 +601,38 @@ class LlamaForCausalLM(Layer):
         return greedy_generate(self, input_ids, max_new_tokens, **kw)
 
 
+def draft_model_from(model, params=None, num_layers: int = 1):
+    """A truncated-target draft model for speculative decoding: the same
+    architecture at ``num_layers`` decoder blocks, REUSING the target's
+    embedding, first ``num_layers`` blocks, final norm and LM head
+    (jax arrays are immutable, so "reuse" is zero-copy aliasing — the
+    only new memory is the draft's own KV cache, owned by the engine's
+    :class:`~paddle_tpu.serving.drafter.DraftModelDrafter`).
+
+    Truncation is the cheapest well-aligned drafter: it shares the
+    target's vocabulary and embedding geometry exactly, so its proposal
+    distribution q lives on the same support as the target's p — the
+    shape the rejection-sampling acceptance needs.  Returns
+    ``(draft_model, draft_params)``; ``params`` defaults to the
+    target's own ``state_dict(include_buffers=True)`` (pass the
+    engine's mesh-placed params to alias placed shards instead).
+    """
+    import dataclasses
+    n = int(num_layers)
+    if not 1 <= n <= model.config.num_hidden_layers:
+        raise ValueError(
+            f"num_layers must be in [1, {model.config.num_hidden_layers}]"
+            f", got {n}")
+    cfg = dataclasses.replace(model.config, num_hidden_layers=n)
+    draft = LlamaForCausalLM(cfg)
+    src = (params if params is not None
+           else model.state_dict(include_buffers=True))
+    merged = type(src)(
+        (k, src[k] if k in src else v)
+        for k, v in draft.state_dict(include_buffers=True).items())
+    return draft, merged
+
+
 # ---------------------------------------------------------------------------
 # pipeline-parallel form: the same model as a flat list of LayerDescs
 # (parity: PaddleNLP's LlamaForCausalLMPipe built on fleet's PipelineLayer)

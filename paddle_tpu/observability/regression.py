@@ -371,6 +371,34 @@ def check_history(root: Optional[str] = None,
                 f"replicas in {fl.get('host_wall_s')} s host "
                 f"(sim {fl.get('sim_wall_s')} s)"))
 
+    # spec_model (ISSUE 20): the committed drafter A/B must keep the
+    # draft-model win — accepted/step strictly above n-gram on the
+    # novel-text trace (where prompt-lookup starves), greedy parity on
+    # both traces, deterministic replay, zero lint findings, and the
+    # mesh trace actually routed to the shard_map Pallas path
+    smr = cpu.get("spec_model", {})
+    if smr:
+        mesh_ok = any(
+            r.get("chosen_path") == "pallas_decode_shard_map"
+            for r in smr.get("mesh_paths", [])) \
+            or not smr.get("mesh_paths")
+        ok = (bool(smr.get("model_beats_ngram_on_novel"))
+              and bool(smr.get("novel_text", {}).get("greedy_parity"))
+              and bool(smr.get("repetition_heavy", {})
+                       .get("greedy_parity"))
+              and bool(smr.get("deterministic_replay"))
+              and int(smr.get("lint_findings", 1)) == 0
+              and mesh_ok)
+        checks.append(_check(
+            "spec_model_row", ok,
+            f"model_beats_ngram_on_novel="
+            f"{smr.get('model_beats_ngram_on_novel')} parity="
+            f"{smr.get('novel_text', {}).get('greedy_parity')}/"
+            f"{smr.get('repetition_heavy', {}).get('greedy_parity')} "
+            f"deterministic={smr.get('deterministic_replay')} "
+            f"lint_findings={smr.get('lint_findings')} "
+            f"shard_map_routed={mesh_ok}"))
+
     # multihost_obs (ISSUE 19): the committed federated-observability
     # row must keep its fidelity invariants — every worker's recovered
     # clock offset inside the estimator's own min-RTT error bound, the

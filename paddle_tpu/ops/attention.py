@@ -29,18 +29,63 @@ from . import _dispatch
 
 NEG_INF = -1e30
 
+# -- structured fallback reasons ------------------------------------------
+# Every Pallas→XLA demotion carries a KIND, and the kind — not a string
+# match on the message — decides whether the fallback is logged.  The
+# contract (pinned by tests/test_attention.py):
+#   backend  XLA is simply the right path (no Pallas backend) — silent
+#   mesh     bare mesh-sharded trace the shard_map fast path can't take
+#            (per-shard geometry/batch ineligible) — silent by design
+#   policy   deliberate routing the bench justified (the min_len
+#            threshold, decode extra_mask) — silent
+#   feature  a caller-requested feature outside the kernel's contract
+#            (dropout, a custom training mask) — WARN once (the caller
+#            asked for the fast path's regime and silently left it)
+#   shape    geometry the kernel cannot take at all — WARN (a shape
+#            quietly sliding off the fast path is a perf surprise)
+#   kernel   the kernel itself refused at call time — WARN (dispatch
+#            and kernel disagree; the dispatch-agreement lint's regime)
+KIND_BACKEND = "backend"
+KIND_MESH = "mesh"
+KIND_POLICY = "policy"
+KIND_FEATURE = "feature"
+KIND_SHAPE = "shape"
+KIND_KERNEL = "kernel"
+WARN_KINDS = frozenset({KIND_FEATURE, KIND_SHAPE, KIND_KERNEL})
 
-def _fallback(reason: str, warn: bool = True):
+
+class FallbackReason(str):
+    """A fallback reason: a plain ``str`` (every existing consumer keeps
+    matching on text) that also carries its ``kind`` — the structured
+    half the warn gates read.  Reasons of unknown provenance (a bare
+    string from an older call site) default to ``kernel``, the loud
+    kind: an unclassified fallback should be seen, not buried."""
+
+    kind = KIND_KERNEL
+
+    def __new__(cls, text, kind: str = KIND_KERNEL):
+        self = str.__new__(cls, text)
+        self.kind = kind
+        return self
+
+
+def reason_kind(reason) -> str:
+    """The kind of a fallback reason (``kernel`` for bare strings)."""
+    return getattr(reason, "kind", KIND_KERNEL)
+
+
+def _fallback(reason):
     """Record a Pallas→XLA fallback: error under FLAGS_flash_attention_force,
     else a one-shot VLOG(1) per distinct reason (round-2 verdict weak #3 —
     a silent fallback is a large unexplained perf regression on TPU).
-    ``warn=False`` skips the log (non-Pallas backends, where the XLA path
-    is simply the right path) but still honours the force flag."""
+    Whether the log fires is the reason KIND's call (``WARN_KINDS``):
+    backend/mesh/policy demotions are the design, shape/kernel demotions
+    are surprises."""
     if flags.flag("flash_attention_force"):
         raise RuntimeError(
             f"flash_attention: Pallas kernel ineligible ({reason}) and "
             f"FLAGS_flash_attention_force is set")
-    if warn:
+    if reason_kind(reason) in WARN_KINDS:
         vlog_once(1, f"flash_attention:{reason}",
                   f"flash_attention: falling back to the XLA reference "
                   f"path ({reason})")
@@ -166,8 +211,11 @@ def _mesh_sharded_trace() -> bool:
     ``shard_map``/pmap body the trace is PER-SHARD (a named axis env is
     bound) and the kernel is exactly right — ring/context-parallel
     attention already runs Pallas that way — so those traces are
-    exempt.  Wiring the decode kernel itself through ``shard_map`` is
-    the future mesh fast path this dispatch rule gates."""
+    exempt.  The decode dispatch wires exactly that: an eligible
+    mesh-sharded decode shape re-enters through
+    :func:`_shard_map_decode_attention` (kv-heads split over mp, rows
+    over dp/sharding) and only the ineligible remainder demotes to the
+    XLA gather path."""
     from ..distributed import env as _denv
     mesh = _denv.active_mesh()
     if mesh is None:
@@ -215,23 +263,130 @@ def decode_shape_gate(s, hq, hkv, d, kv_len, paged_block_len=None):
     return "pallas_decode", None
 
 
+def _shard_map_eligible(b, s, hq, hkv, d, kv_len, has_extra_mask,
+                        paged_block_len) -> Optional[str]:
+    """Can this bare mesh-sharded decode shape take the Pallas kernel
+    PER SHARD under :func:`_shard_map_decode_attention`?  ``None`` when
+    eligible, else the blocking condition.  Eligibility = the mesh only
+    spans the decode axes (mp over kv-heads, dp/sharding over rows),
+    both head counts and the batch divide evenly, and the PER-SHARD
+    geometry (Hq/mp, Hkv/mp heads) passes the same policy + shape gates
+    a single-chip shape does — so the per-shard trace inside the
+    shard_map body re-dispatches straight onto the kernel."""
+    from .. import flags as _flags
+    from ..distributed import env as _denv
+    mesh = _denv.active_mesh()
+    axes = {a: mesh.shape[a] for a in mesh.axis_names if mesh.shape[a] > 1}
+    extra = sorted(a for a in axes if a not in ("mp", "dp", "sharding"))
+    if extra:
+        return f"mesh axes {extra} beyond mp/dp/sharding"
+    mp = axes.get("mp", 1)
+    rows = axes.get("dp", 1) * axes.get("sharding", 1)
+    if hkv == 0 or hq % mp or hkv % mp:
+        return f"heads (hq={hq}, hkv={hkv}) not divisible by mp={mp}"
+    if b % rows:
+        return f"batch {b} not divisible by dp*sharding={rows}"
+    if has_extra_mask:
+        return "extra_mask"
+    if kv_len < int(_flags.flag("decode_attention_min_len")):
+        return f"kv_len {kv_len} < FLAGS_decode_attention_min_len"
+    path, why = decode_shape_gate(s, hq // mp, hkv // mp, d, kv_len,
+                                  paged_block_len)
+    if path != "pallas_decode":
+        return f"per-shard shape: {why}"
+    return None
+
+
 def _decode_attention_decision(b, s, hq, hkv, d, kv_len, has_extra_mask,
                                paged_block_len):
     from .. import flags as _flags
     if not _dispatch.use_pallas():
-        return "xla_math", (f"no Pallas-capable backend "
-                            f"({_dispatch.default_backend()})")
+        return "xla_math", FallbackReason(
+            f"no Pallas-capable backend ({_dispatch.default_backend()})",
+            KIND_BACKEND)
     if _mesh_sharded_trace():
-        return "xla_math", ("mesh-sharded trace: Pallas-under-shard_map "
-                            "is not wired; the XLA gather path "
-                            "partitions under GSPMD")
+        blocked = _shard_map_eligible(b, s, hq, hkv, d, kv_len,
+                                      has_extra_mask, paged_block_len)
+        if blocked is None:
+            # the mesh fast path: wrap the per-shard kernel in shard_map
+            # (kv-heads over mp, rows over dp/sharding — the output
+            # stays row-parallel, no new collectives)
+            return "pallas_decode_shard_map", None
+        return "xla_math", FallbackReason(
+            f"mesh-sharded trace: {blocked}; the XLA gather path "
+            f"partitions under GSPMD", KIND_MESH)
     if has_extra_mask:
-        return "xla_math", "extra_mask"
+        return "xla_math", FallbackReason("extra_mask", KIND_POLICY)
     if kv_len < int(_flags.flag("decode_attention_min_len")):
-        return "xla_math", (f"kv_len {kv_len} < "
-                            f"FLAGS_decode_attention_min_len (XLA at the "
-                            f"weight-stream bound there)")
-    return decode_shape_gate(s, hq, hkv, d, kv_len, paged_block_len)
+        return "xla_math", FallbackReason(
+            f"kv_len {kv_len} < FLAGS_decode_attention_min_len (XLA at "
+            f"the weight-stream bound there)", KIND_POLICY)
+    path, why = decode_shape_gate(s, hq, hkv, d, kv_len, paged_block_len)
+    if why is not None:
+        why = FallbackReason(why, KIND_SHAPE)
+    return path, why
+
+
+def _shard_map_decode_attention(q, k_cache, v_cache, pos, scale=None,
+                                live_len=None, block_tables=None,
+                                k_scale=None, v_scale=None):
+    """The mesh fast path: re-enter :func:`cached_decode_attention`
+    PER SHARD under ``shard_map`` — kv-heads split over ``mp`` (exactly
+    how mp attention layers place them: contiguous head blocks, so the
+    GQA group structure survives the split), rows over ``dp``/
+    ``sharding``.  Inside the body a named axis env is bound, so
+    ``_mesh_sharded_trace()`` is False and the per-shard dispatch
+    re-runs at Hq/mp × Hkv/mp geometry — counting its own
+    ``pallas_decode`` row and degrading per shard to the XLA math path
+    if the kernel refuses at call time.  Attention is embarrassingly
+    parallel over rows and kv-head groups, so the body needs NO
+    collectives and the output stays row-parallel (the PR-8 comm model
+    is unchanged).
+
+    Paged layout: the pool is head-sharded only (every shard holds all
+    blocks at its head slice) and the block tables are per-row logical
+    — they ride the row axes with their rows, whole per shard."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed import env as _denv
+    mesh = _denv.active_mesh()
+    names = set(mesh.axis_names)
+    batch = tuple(a for a in ("dp", "sharding") if a in names) or None
+    mp = "mp" if "mp" in names else None
+    paged = block_tables is not None
+    quantized = k_scale is not None
+    q_spec = P(batch, None, mp, None)
+    kv_spec = P(None, None, mp, None) if paged else P(batch, None, mp,
+                                                      None)
+    args = [q, k_cache, v_cache, pos]
+    in_specs = [q_spec, kv_spec, kv_spec,
+                P(batch) if getattr(pos, "ndim", 0) == 1 else P()]
+    if paged:
+        args.append(block_tables)
+        in_specs.append(P(batch, None))
+    if quantized:
+        s_spec = P(None, mp) if paged else P(batch, None, mp)
+        args += [jnp.asarray(k_scale, jnp.float32),
+                 jnp.asarray(v_scale, jnp.float32)]
+        in_specs += [s_spec, s_spec]
+
+    def body(*ops):
+        q_, k_, v_, pos_ = ops[:4]
+        i = 4
+        bt_ = ks_ = vs_ = None
+        if paged:
+            bt_ = ops[i]
+            i += 1
+        if quantized:
+            ks_, vs_ = ops[i], ops[i + 1]
+        return cached_decode_attention(q_, k_, v_, pos_, scale=scale,
+                                       live_len=live_len,
+                                       block_tables=bt_,
+                                       k_scale=ks_, v_scale=vs_)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=q_spec, check_vma=False)
+    return fn(*args)
 
 
 def cached_decode_attention(q, k_cache, v_cache, pos,
@@ -288,7 +443,15 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
         path, reason = decode_attention_path(b, s, hq, hkv, d, kv_len,
                                              extra_mask is not None,
                                              quantized=quantized)
-    if path == "pallas_decode":
+    if path == "pallas_decode_shard_map":
+        try:
+            return _shard_map_decode_attention(
+                q, k_cache, v_cache, pos, scale=scale, live_len=live_len,
+                block_tables=block_tables,
+                k_scale=k_scale, v_scale=v_scale)
+        except NotImplementedError as e:
+            reason = FallbackReason(str(e), KIND_KERNEL)
+    elif path == "pallas_decode":
         try:
             from .pallas.decode_attention import decode_attention_pallas
             return decode_attention_pallas(
@@ -297,12 +460,11 @@ def cached_decode_attention(q, k_cache, v_cache, pos,
                 k_scale=k_scale, v_scale=v_scale,
                 interpret=_dispatch.pallas_interpret())
         except NotImplementedError as e:
-            reason = str(e)
-    if _dispatch.use_pallas() and not reason.startswith(
-            ("no Pallas", "kv_len", "extra_mask", "paged block_len",
-             "mesh-sharded")):
-        # an above-threshold shape falling back IS a perf surprise worth
-        # one log line; below-threshold / masked shapes are the design
+            reason = FallbackReason(str(e), KIND_KERNEL)
+    if _dispatch.use_pallas() and reason_kind(reason) in WARN_KINDS:
+        # shape/kernel demotions ARE perf surprises worth one log line;
+        # backend/mesh/policy demotions are the design (see the kind
+        # contract at the top of this module)
         vlog_once(1, f"decode_attention:{reason}",
                   f"cached_decode_attention: falling back to the XLA math "
                   f"path ({reason})")
@@ -497,22 +659,26 @@ def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
     if kv_segment_ids is not None and segment_ids is None:
         raise ValueError("kv_segment_ids requires segment_ids")
     if not _dispatch.use_pallas():
-        _fallback("no Pallas-capable backend "
-                  f"({_dispatch.default_backend()})", warn=False)
+        _fallback(FallbackReason(
+            "no Pallas-capable backend "
+            f"({_dispatch.default_backend()})", KIND_BACKEND))
     else:
         reason = None
         if _mesh_sharded_trace():
             # same gate as the decode dispatch: a bare pallas_call would
             # force GSPMD to replicate its operands; the XLA reference
             # partitions cleanly, so the fallback IS the design here
-            # (warn=False below skips the one-shot log for it)
-            reason = "mesh-sharded trace (GSPMD partitions the XLA path)"
+            # (the mesh kind keeps it out of the one-shot log)
+            reason = FallbackReason(
+                "mesh-sharded trace (GSPMD partitions the XLA path)",
+                KIND_MESH)
         elif dropout_p != 0.0:
-            reason = "dropout_p != 0"
+            reason = FallbackReason("dropout_p != 0", KIND_FEATURE)
         elif attn_mask is not None:
-            reason = "custom attn_mask"
+            reason = FallbackReason("custom attn_mask", KIND_FEATURE)
         elif q.shape[-1] > 256:
-            reason = f"head_dim {q.shape[-1]} > 256"
+            reason = FallbackReason(f"head_dim {q.shape[-1]} > 256",
+                                    KIND_SHAPE)
         if reason is None:
             try:
                 from .pallas.flash_attention import flash_attention_pallas
@@ -524,8 +690,8 @@ def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                 _dispatch.count_kernel_path("flash_attention", "pallas")
                 return (out, lse) if return_lse else out
             except NotImplementedError as e:
-                reason = str(e)
-        _fallback(reason, warn=not reason.startswith("mesh-sharded"))
+                reason = FallbackReason(str(e), KIND_KERNEL)
+        _fallback(reason)
     _dispatch.count_kernel_path("flash_attention", "xla_reference")
     if segment_ids is not None:
         seg = segment_mask(segment_ids,

@@ -13,7 +13,7 @@ admits queued requests into freed slots mid-flight.
 
 from .admission import HoldQueue, Verdict, place_verdict
 from .autoscaler import ReplicaAutoscaler
-from .drafter import NgramDrafter
+from .drafter import Drafter, DraftModelDrafter, NgramDrafter
 from .engine import Request, SamplingParams, ServingEngine
 from .fleet_sim import FleetSim, SimEngine, SimSpec, run_fleet
 from .kv_cache import BlockManager, init_paged_kv_cache
@@ -21,7 +21,8 @@ from .loadgen import LoadRequest, LoadSpec, generate_load, replay
 from .router import ReplicaRouter
 
 __all__ = ["ServingEngine", "SamplingParams", "Request", "BlockManager",
-           "init_paged_kv_cache", "NgramDrafter", "ReplicaRouter",
+           "init_paged_kv_cache", "Drafter", "DraftModelDrafter",
+           "NgramDrafter", "ReplicaRouter",
            "LoadRequest", "LoadSpec", "generate_load", "replay",
            "HoldQueue", "Verdict", "place_verdict", "ReplicaAutoscaler",
            "FleetSim", "SimEngine", "SimSpec", "run_fleet"]

@@ -1,31 +1,66 @@
-"""Host-side self-drafting proposers for speculative decoding.
+"""Drafting proposers for speculative decoding, behind one ``Drafter``
+interface.
 
-The serving engine's spec-decode mode (engine.py) needs a cheap source of
+The serving engine's spec-decode mode (engine.py) needs a source of
 draft tokens: candidates the once-jitted verify step can score k at a
 time through the q-tiled flash-decode path, so an accepted draft costs a
-fraction of a weight pass instead of a whole one.  A second draft *model*
-would buy the best acceptance rates (Leviathan et al. 2023) but drags in
-a second set of weights, its own KV state and a second compiled program;
-**prompt lookup / n-gram self-drafting** (the vLLM ``ngram`` speculator,
-PLD) gets most of the win for free on the workloads speculative decoding
-targets anyway — summarisation, code edits, RAG, chat with long shared
-context — where the continuation frequently restates spans that already
-appear in the prompt or in the tokens generated so far.
+fraction of a weight pass instead of a whole one.  Two proposers:
 
-Everything here is pure host-side numpy over each slot's token history;
-nothing touches the device or the compiled step (a proposal is just data
-riding the verify step's static (num_slots, k) draft operand, pad-masked
-where the drafter had nothing to say).
+  * :class:`NgramDrafter` — **prompt lookup / n-gram self-drafting**
+    (the vLLM ``ngram`` speculator, PLD): pure host-side numpy over each
+    slot's token history, free but unable to draft *novel* text — it
+    only restates spans already present in the history.  Its proposal
+    distribution is the one-hot at each drafted token (a deterministic
+    proposer), which is what the rejection-sampling acceptance
+    (models/generation.py ``accept_draft_tokens``) sees for it;
+  * :class:`DraftModelDrafter` — a small draft **model** sharing the
+    engine (Leviathan et al. 2023): a second param set placed by the
+    same ``decode_mesh_specs`` machinery, its own tiny contiguous KV
+    cache (fixed depth, no allocator) and its own once-jitted draft
+    step at q-depth k.  It drafts novel text and emits the true
+    proposal distribution q, so sampled rows speculate with the exact
+    target distribution under rejection sampling.
+
+A proposal is just data riding the verify step's static (num_slots, k)
+draft operand (plus the (num_slots, k, V) proposal-distribution
+operand), pad-masked where the drafter had nothing to say.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
-__all__ = ["NgramDrafter"]
+__all__ = ["Drafter", "NgramDrafter", "DraftModelDrafter"]
 
 
-class NgramDrafter:
+class Drafter:
+    """Interface both proposers implement.  ``kind`` labels lifecycle
+    events and the ``drafter=`` axis of the spec counters; host-side
+    proposers implement :meth:`propose` (per slot), device-side ones
+    implement :meth:`propose_batch` (whole slot batch, one compiled
+    call) — the engine dispatches on ``uses_device``."""
+
+    kind: str = "custom"
+    uses_device: bool = False
+
+    def propose(self, history) -> np.ndarray:
+        """Draft tokens following ``history``: int32 (m,), 0 <= m <= k;
+        empty means "no proposal — the row decodes plain"."""
+        raise NotImplementedError
+
+    def reset_slot(self, i: int) -> None:
+        """Forget any per-slot state (slot ``i`` was (re)assigned)."""
+
+    def rollback(self, i: int) -> None:
+        """A verify step rejected drafts for slot ``i`` — stateful
+        proposers drop anything speculated past the committed stream.
+        (Both built-ins track committed history only, so this is a
+        no-op hook.)"""
+
+
+class NgramDrafter(Drafter):
     """Prompt-lookup proposer: match the history's tail n-gram against
     its own earlier occurrences and propose the tokens that followed.
 
@@ -38,6 +73,8 @@ class NgramDrafter:
     lifted verbatim from the history, which is what makes the scheme
     free: no model, no state, no trace.
     """
+
+    kind = "ngram"
 
     def __init__(self, k: int, max_ngram: int = 3, min_ngram: int = 1):
         if k < 1:
@@ -67,3 +104,232 @@ class NgramDrafter:
                 i = int(hits[-1])
                 return h[i + n:i + n + self.k].astype(np.int32)
         return np.zeros((0,), np.int32)
+
+
+class DraftModelDrafter(Drafter):
+    """Draft-MODEL proposer (Leviathan et al. 2023): a small causal LM
+    rides the engine and autoregressively proposes k tokens per slot per
+    tick, emitting the proposal distribution q the rejection-sampling
+    acceptance needs.
+
+    Engine-shaped by construction:
+
+      * its KV cache is one CONTIGUOUS stacked array
+        ``(L_draft, 2, num_slots, max_length, Hkv, D)`` — fixed depth,
+        no allocator, no block tables; a draft row only ever holds the
+        committed stream plus this tick's in-flight speculation, and
+        stale speculative cells are overwritten sequentially before any
+        later query can attend them (the same scatter-then-read layer
+        order the verify window relies on);
+      * TWO once-jitted programs, each under its own retrace budget of
+        1: the **draft step** (window of up to k+1 caught-up history
+        tokens at per-row start positions, then k sampled continuations
+        — greedy rows take the argmax, sampled rows draw from q =
+        softmax of the draft logits, and q is returned per column) and
+        the fixed-width **ingest step** that drains long backlogs
+        (admission / resume / import hand the drafter a cold slot and
+        the whole prompt catches up through it, ``ingest_width`` tokens
+        per call);
+      * idle or non-participating rows are steered to
+        ``start = max_length`` so their cache scatters drop out of
+        bounds — the engine's existing idle-row write convention;
+      * per-slot ``consumed`` counters track COMMITTED history only, so
+        verify-step rollback needs no draft-side undo: the next tick's
+        window simply rewrites from the committed frontier.
+
+    On a mesh engine the draft params/cache are placed by the same
+    ``decode_mesh_specs`` machinery as the target's, and both programs
+    jit with declared shardings (params/cache per spec, small operands
+    replicated) under the engine's mesh scope.
+
+    ``model``/``params`` default to the TARGET model acting as its own
+    drafter ("self-drafting at full strength") — useful for tests and
+    as the acceptance-rate ceiling; pass a truncated model from
+    :func:`paddle_tpu.models.llama.draft_model_from` for a real draft.
+    """
+
+    kind = "model"
+    uses_device = True
+
+    def __init__(self, k: int, model, params, num_slots: int,
+                 max_length: int, pad_token_id: int = 0, mesh=None,
+                 engine_id: str = "0", ingest_width: int = 16):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.k = int(k)
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.max_length = int(max_length)
+        self.pad_token_id = int(pad_token_id)
+        self.mesh = mesh
+        self.ingest_width = max(int(ingest_width), self.k + 1)
+        self._eid = str(engine_id)
+        self._bind = getattr(model, "unwrapped", model)
+        self._prepare = getattr(model, "_prepare_params", lambda p: p)
+        self._consumed = np.zeros((self.num_slots,), np.int64)
+        self._params = params
+        self._cache = None        # built (and mesh-placed) on first use
+        self._draft_fn = None
+        self._ingest_fn = None
+
+    # -- per-slot lifecycle hooks (engine admission/retire/resume) ----
+    def reset_slot(self, i: int) -> None:
+        self._consumed[i] = 0
+
+    @property
+    def draft_traces(self) -> int:
+        """Compilations of the draft step (jit.traces read-through; the
+        budget, like the verify step's, is exactly 1)."""
+        return (int(self._draft_fn.traces)
+                if self._draft_fn is not None else 0)
+
+    # -- jitted bodies ------------------------------------------------
+    def _draft_impl(self, params, cache, window, start, nvalid, temps,
+                    key):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer import bind_params
+
+        with bind_params(self._bind, self._prepare(params)):
+            logits, cache = self.model.decode_step(window, cache, start)
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(nvalid - 1, 0)[:, None, None],
+                axis=1)[:, 0]                              # (S, V)
+            drafts, probs = [], []
+            for j in range(self.k):
+                lg = last.astype(jnp.float32)
+                probs.append(jax.nn.softmax(lg, axis=-1))
+                tok = jnp.where(
+                    temps <= 0.0,
+                    jnp.argmax(lg, axis=-1).astype(jnp.int32),
+                    jax.random.categorical(
+                        jax.random.fold_in(key, j), lg,
+                        axis=-1).astype(jnp.int32))
+                drafts.append(tok)
+                if j < self.k - 1:
+                    logits, cache = self.model.decode_step(
+                        tok[:, None], cache, start + nvalid + j)
+                    last = logits[:, 0]
+            return (jnp.stack(drafts, axis=1),
+                    jnp.stack(probs, axis=1), cache)
+
+    def _ingest_impl(self, params, cache, window, start):
+        from ..nn.layer import bind_params
+
+        with bind_params(self._bind, self._prepare(params)):
+            _, cache = self.model.decode_step(window, cache, start)
+            return cache
+
+    def _build(self):
+        """First-use setup: allocate (and mesh-place) the draft cache,
+        jit the two programs under their retrace budgets."""
+        import jax.numpy as jnp
+
+        from .. import observability as _obs
+        from ..models.generation import _place_on_mesh, init_kv_cache
+
+        self._cache = init_kv_cache(self.model.config, self.num_slots,
+                                    self.max_length)
+        self._params, self._cache, _ = _place_on_mesh(
+            self._bind, self._params, self._cache,
+            jnp.zeros((self.num_slots,), jnp.int32), mesh=self.mesh)
+        lbl = {"engine": self._eid}
+        dkw = {"donate_argnums": (1,)}
+        ikw = {"donate_argnums": (1,)}
+        if self.mesh is not None:
+            dkw.update(self._jit_shardings(7, 3))
+            ikw.update(self._jit_shardings(4, 1))
+        self._draft_fn = _obs.track_retraces(
+            self._under_mesh(self._draft_impl), "serving.draft_step",
+            budget=1, labels=lbl, **dkw)
+        self._ingest_fn = _obs.track_retraces(
+            self._under_mesh(self._ingest_impl), "serving.draft_prefill",
+            budget=1, labels=lbl, **ikw)
+
+    def _under_mesh(self, impl):
+        if self.mesh is None:
+            return impl
+        import functools
+
+        from ..distributed import env as _denv
+
+        @functools.wraps(impl)
+        def traced_under_mesh(*args):
+            with _denv.use_mesh(self.mesh):
+                return impl(*args)
+        return traced_under_mesh
+
+    def _jit_shardings(self, n_args, n_out):
+        """Declared shardings mirroring the engine's step programs:
+        draft params/cache per ``decode_mesh_specs``, everything else
+        replicated, the cache the trailing output."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..models.generation import decode_mesh_specs
+
+        param_specs, cache_spec, _ = decode_mesh_specs(
+            self._bind, self._params, self.mesh.axis_names)
+
+        def ns(spec):
+            return NamedSharding(self.mesh, spec)
+
+        repl = ns(P())
+        in_sh = [repl] * n_args
+        in_sh[0] = jax.tree_util.tree_map(ns, param_specs)
+        in_sh[1] = ns(cache_spec)
+        out_sh = (ns(cache_spec) if n_out == 1
+                  else tuple([repl] * (n_out - 1) + [ns(cache_spec)]))
+        return {"in_shardings": tuple(in_sh), "out_shardings": out_sh}
+
+    # -- the engine-facing batched call -------------------------------
+    def propose_batch(self, histories, temps, seed: int):
+        """One tick's proposals for the slots in ``histories`` (dict
+        ``slot -> int32 committed token stream``, last entry the token
+        about to be fed).  Returns ``(drafts (S, k) int32, probs
+        (S, k, V) f32)`` over the FULL slot batch — rows absent from
+        ``histories`` are pad/zero and steered out of bounds on the
+        device.  ``temps``: the engine's (S,) per-slot temperatures;
+        ``seed``: the tick's deterministic draw."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._cache is None:
+            self._build()
+        s, k = self.num_slots, self.k
+        # drain cold/long backlogs through the fixed-width ingest step
+        while True:
+            over = {i: h for i, h in histories.items()
+                    if h.size - self._consumed[i] > k + 1}
+            if not over:
+                break
+            iw = np.full((s, self.ingest_width), self.pad_token_id,
+                         np.int32)
+            ist = np.full((s,), self.max_length, np.int32)
+            for i, h in over.items():
+                c = int(self._consumed[i])
+                n = min(self.ingest_width, h.size - c - (k + 1))
+                iw[i, :n] = h[c:c + n]
+                ist[i] = c
+                self._consumed[i] = c + n
+            self._cache = self._ingest_fn(
+                self._params, self._cache, jnp.asarray(iw),
+                jnp.asarray(ist))
+        win = np.full((s, k + 1), self.pad_token_id, np.int32)
+        start = np.full((s,), self.max_length, np.int32)
+        nval = np.zeros((s,), np.int32)
+        for i, h in histories.items():
+            c = int(self._consumed[i])
+            n = h.size - c                       # 1 .. k+1 by the drain
+            win[i, :n] = h[c:]
+            start[i] = c
+            nval[i] = n
+            self._consumed[i] = h.size
+        drafts, probs, self._cache = self._draft_fn(
+            self._params, self._cache, jnp.asarray(win),
+            jnp.asarray(start), jnp.asarray(nval),
+            jnp.asarray(temps, jnp.float32),
+            jax.random.fold_in(jax.random.key(0), int(seed)))
+        return np.asarray(drafts), np.asarray(probs, np.float32)

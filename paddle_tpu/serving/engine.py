@@ -158,7 +158,7 @@ from ..models.generation import (_place_on_mesh, accept_draft_tokens,
                                  sample_tokens)
 from ..nn.layer import bind_params
 from ..ops import _dispatch as _disp
-from .drafter import NgramDrafter
+from .drafter import DraftModelDrafter, NgramDrafter
 from .kv_cache import BlockManager, init_paged_kv_cache
 
 __all__ = ["ServingEngine", "SamplingParams", "Request"]
@@ -227,6 +227,9 @@ class Request:
     defer_ticks: int = 0               # predictive-admission deferrals
     priority: int = 0                  # preemption class (higher wins)
     preempt_count: int = 0             # times this request was preempted
+    # per-request drafter override (spec mode): 'ngram' | 'model' | a
+    # Drafter instance | None = the engine default
+    drafter: Optional[object] = None
     # recompute-resume marker: set ONLY on the synthetic re-prefill
     # request a recompute preemption enqueues (see _do_preempt)
     resume: Optional["_ResumeInfo"] = None
@@ -308,7 +311,9 @@ class ServingEngine:
                  int8_weights: Optional[bool] = None,
                  mesh=None,
                  preempt: Optional[str] = None,
-                 host_blocks: Optional[int] = None):
+                 host_blocks: Optional[int] = None,
+                 drafter=None,
+                 draft_model=None):
         """``paged`` (default FLAGS_serving_paged_kv) selects the paged
         block-pool cache; ``block_len`` (FLAGS_kv_cache_block_len) and
         ``num_blocks`` (FLAGS_kv_cache_num_blocks; 0 derives the
@@ -327,13 +332,24 @@ class ServingEngine:
         half the prompt-ingest rate).
 
         ``spec_decode`` (default FLAGS_serving_spec_decode) selects
-        speculative decoding: the n-gram self-drafter proposes up to
-        ``spec_k`` (FLAGS_serving_spec_k) tokens per greedy slot per
-        tick and one verify step commits the longest verified prefix —
-        greedy outputs token-identical to plain decode, 1..k+1 tokens
-        per step.  Composes with every cache layout and with chunked
-        prefill (the verify window replaces the mixed step's decode
-        half).
+        speculative decoding: a drafter proposes up to ``spec_k``
+        (FLAGS_serving_spec_k) tokens per slot per tick and one verify
+        step commits the longest accepted prefix — greedy outputs
+        token-identical to plain decode, sampled rows exact under
+        rejection sampling, 1..k+1 tokens per step.  Composes with
+        every cache layout and with chunked prefill (the verify window
+        replaces the mixed step's decode half).
+
+        ``drafter`` (default FLAGS_serving_spec_drafter) picks the
+        proposer: ``'ngram'`` (host-side prompt lookup), ``'model'``
+        (a draft model sharing the engine — see ``draft_model``), or a
+        :class:`~paddle_tpu.serving.drafter.Drafter` instance.
+        ``draft_model``: the draft model for kind ``'model'`` — a
+        ``(model, params)`` pair, a bare model (its own state_dict is
+        taken), or ``None`` for self-drafting with the TARGET model
+        (zero extra weights; the acceptance-rate ceiling).
+        ``submit(drafter=...)`` overrides per request, so one engine
+        can mix drafter kinds across its slot batch.
 
         ``mesh`` (default FLAGS_serving_mesh) makes the engine
         MESH-NATIVE — the tensor-parallel execution path of ROADMAP
@@ -422,10 +438,12 @@ class ServingEngine:
         if self.spec and self.spec_k < 1:
             raise ValueError(
                 f"spec_k must be >= 1, got {self.spec_k}")
-        if self.spec:
-            self._drafter = NgramDrafter(
-                self.spec_k,
-                max_ngram=int(_flags.flag("serving_spec_ngram")))
+        # drafter construction is deferred past param placement (the
+        # draft-model drafter aliases the PLACED params for self-draft)
+        self._drafter_arg = drafter
+        self._draft_model_arg = draft_model
+        self._drafters: Dict[str, object] = {}
+        self._drafter = None
         # preemptive scheduling + host KV tier (ISSUE 16).  'swap'
         # parks a victim's private blocks on the pinned host pool and
         # restores them verbatim; 'recompute' frees the chain and
@@ -496,6 +514,12 @@ class ServingEngine:
             jnp.zeros((self.num_slots, 1), jnp.int32),
             paged_cache=self.paged, mesh=self.mesh)
         self._params, self._cache = params, cache
+        if self.spec:
+            sel = (self._drafter_arg if self._drafter_arg is not None
+                   else str(_flags.flag("serving_spec_drafter")))
+            self._drafter = self._make_drafter(sel)
+            self._drafters[getattr(self._drafter, "kind", "custom")] = \
+                self._drafter
         self._pending_demote: List[int] = []
         if self.paged:
             # COW device copy (compiled once; only dispatched when a
@@ -868,6 +892,58 @@ class ServingEngine:
             return None
         return m
 
+    def _make_drafter(self, sel):
+        """Build a drafter from a selector: a Drafter instance passes
+        through; ``'ngram'``/``'model'`` build the corresponding
+        proposer (the model drafter aliases the engine's placed params
+        when no ``draft_model`` was given — self-drafting)."""
+        if not isinstance(sel, str):
+            return sel
+        if sel == "ngram":
+            return NgramDrafter(
+                self.spec_k,
+                max_ngram=int(_flags.flag("serving_spec_ngram")))
+        if sel == "model":
+            src = self._draft_model_arg
+            if src is None:
+                dm, dp = self.model, self._params
+            elif isinstance(src, (tuple, list)):
+                dm, dp = src
+            else:
+                dm, dp = src, src.state_dict(include_buffers=True)
+            return DraftModelDrafter(
+                self.spec_k, dm, dp, self.num_slots, self.max_length,
+                pad_token_id=self.pad_token_id, mesh=self.mesh,
+                engine_id=self._eid)
+        raise ValueError(
+            f"drafter must be 'ngram', 'model' or a Drafter instance, "
+            f"got {sel!r}")
+
+    def _drafter_for(self, sel):
+        """Resolve a request's drafter override (``None`` = the engine
+        default); string kinds are built once and shared."""
+        if sel is None:
+            return self._drafter
+        if isinstance(sel, str):
+            d = self._drafters.get(sel)
+            if d is None:
+                d = self._drafters[sel] = self._make_drafter(sel)
+            return d
+        return sel
+
+    def _drafter_reset(self, i: int):
+        """Slot (re)assignment/teardown: clear per-slot drafter state
+        (the draft model's consumed-history counter)."""
+        if not self.spec:
+            return
+        seen = []
+        for d in [self._drafter] + list(self._drafters.values()):
+            if d is not None and d not in seen:
+                seen.append(d)
+                rs = getattr(d, "reset_slot", None)
+                if rs is not None:
+                    rs(i)
+
     def _under_mesh(self, impl):
         """Trace-time mesh scope for a step/prefill body: the model's
         internal sharding constraints (``mp_layers.constrain``) and the
@@ -1012,28 +1088,40 @@ class ServingEngine:
                 **lbl)
         # speculative decoding (serving.spec* conventions: BASELINE.md) —
         # accounting is in COMMITTED tokens; drafted/rejected tokens
-        # never reach serving.tokens_generated or any tok/s number
-        self._m_drafted = ctr(
+        # never reach serving.tokens_generated or any tok/s number.
+        # Every spec series carries a ``drafter=`` label (kind of the
+        # proposer that drafted the row — per-request overrides can mix
+        # kinds in one engine); labeled children are built lazily per
+        # kind via _spec_m.
+        self._f_drafted = ctr(
             "serving.spec_drafted_tokens",
-            "draft tokens the self-drafter proposed (sent to "
-            "verification)").labels(**lbl)
-        self._m_draft_hits = ctr(
+            "draft tokens the drafter proposed (sent to verification)")
+        self._f_draft_hits = ctr(
             "serving.spec_draft_hit_tokens",
-            "proposed draft tokens verified AND committed").labels(**lbl)
-        self._m_draft_miss = ctr(
+            "proposed draft tokens verified AND committed")
+        self._f_draft_miss = ctr(
             "serving.spec_draft_miss_tokens",
             "proposed draft tokens rejected by verification (rolled "
-            "back)").labels(**lbl)
-        self._m_rollbacks = ctr(
+            "back)")
+        self._f_rollbacks = ctr(
             "serving.spec_rollbacks",
             "row-steps whose rejected draft suffix was rolled back "
             "(position pinned at the accept point; paged: draft-only "
-            "blocks returned via truncate_to)").labels(**lbl)
-        self._m_spec_accept = hist(
+            "blocks returned via truncate_to)")
+        self._f_spec_accept = hist(
             "serving.spec_accepted_per_step",
             "tokens committed per active slot per verify step (1 = no "
             "speculative win that step; k+1 = whole window accepted)",
-            buckets=(1, 2, 3, 4, 5, 6, 7, 8, 16)).labels(**lbl)
+            buckets=(1, 2, 3, 4, 5, 6, 7, 8, 16))
+        # engine-total children (the pre-drafter-label series, kept for
+        # dashboards and the metrics() rollup) + lazily-built per-kind
+        # children carrying the drafter= label
+        self._m_drafted = self._f_drafted.labels(**lbl)
+        self._m_draft_hits = self._f_draft_hits.labels(**lbl)
+        self._m_draft_miss = self._f_draft_miss.labels(**lbl)
+        self._m_rollbacks = self._f_rollbacks.labels(**lbl)
+        self._m_spec_accept = self._f_spec_accept.labels(**lbl)
+        self._spec_children: Dict[str, tuple] = {}
         # int8 KV cache (quantization accounting conventions: BASELINE.md)
         self._m_demoted = ctr(
             "serving.kv_demoted_blocks",
@@ -1090,6 +1178,22 @@ class ServingEngine:
             "migration.bytes_in",
             "KV payload bytes written into the pool by "
             "import_request").labels(**lbl)
+
+    def _spec_m(self, kind: str):
+        """The drafter-labeled spec-series children for one drafter
+        kind: (drafted, hits, miss, rollbacks, accept_hist).  Built
+        lazily — kinds are a tiny closed set (ngram/model/custom), so
+        cardinality stays bounded."""
+        m = self._spec_children.get(kind)
+        if m is None:
+            lbl = {"engine": self._eid, "drafter": kind}
+            m = self._spec_children[kind] = (
+                self._f_drafted.labels(**lbl),
+                self._f_draft_hits.labels(**lbl),
+                self._f_draft_miss.labels(**lbl),
+                self._f_rollbacks.labels(**lbl),
+                self._f_spec_accept.labels(**lbl))
+        return m
 
     # -- jitted device programs -------------------------------------------
 
@@ -1230,27 +1334,33 @@ class ServingEngine:
     # -- jitted device programs: speculative decoding ----------------------
 
     def _verify_window(self, params, cache, tokens, positions, draft_ok,
-                       temps, topk, topp, key, block_tables=None):
+                       draft_probs, temps, topk, topp, key,
+                       block_tables=None):
         """The shared verify core of every spec step: score each row's
         (k+1)-token window ``[current, d_1..d_k]`` at its own depth in
         ONE forward — q-depth k+1 rides the q-tiled flash-decode path,
         per-row positions as scalar-prefetch, so all drafts of all slots
         cost a single pass of the weights — then keep each row's longest
         verified prefix plus the bonus token (models/generation.py
-        ``accept_draft_tokens``; sampled rows commit one token, exact
-        distribution).  The kernel_path_hint relabels this trace's
-        dispatch counts as ``op="spec_verify"``."""
+        ``accept_draft_tokens``).  ``draft_probs`` is the (s, k, vocab)
+        proposal-distribution stack q: greedy rows keep the exact
+        prefix-match rule, sampled rows run the rejection-sampling
+        acceptance against q (one-hot for deterministic proposers,
+        the draft model's softmax otherwise) so every committed token
+        is distributed exactly as plain sampling.  The
+        kernel_path_hint relabels this trace's dispatch counts as
+        ``op="spec_verify"``."""
         with bind_params(self._bind, self._prepare(params)):
             with _disp.kernel_path_hint("spec_verify"):
                 logits, cache = self.model.decode_step(
                     tokens, cache, positions, block_tables=block_tables)
         out, n_acc = accept_draft_tokens(
             logits, tokens[:, 1:], draft_ok, key, temps, topk, topp,
-            pad_token_id=self.pad_token_id)
+            pad_token_id=self.pad_token_id, draft_probs=draft_probs)
         return out, n_acc, cache
 
     def _spec_step_impl(self, params, cache, tokens, positions, slot_mask,
-                        draft_ok, temps, topk, topp, key):
+                        draft_ok, draft_probs, temps, topk, topp, key):
         """Speculative twin of ``_step_impl``: ``tokens`` is the
         (num_slots, k+1) window matrix (pad columns where the drafter
         had nothing), ``draft_ok`` the (num_slots, k) real-proposal
@@ -1262,39 +1372,39 @@ class ServingEngine:
         once; a draft-free tick is the same program with all-pad
         windows."""
         out, n_acc, cache = self._verify_window(
-            params, cache, tokens, positions, draft_ok, temps, topk,
-            topp, key)
+            params, cache, tokens, positions, draft_ok, draft_probs,
+            temps, topk, topp, key)
         out = jnp.where(slot_mask[:, None], out,
                         jnp.int32(self.pad_token_id))
         return out, n_acc, cache
 
     def _spec_step_impl_paged(self, params, cache, tokens, positions,
-                              tables, slot_mask, draft_ok, temps, topk,
-                              topp, key):
+                              tables, slot_mask, draft_ok, draft_probs,
+                              temps, topk, topp, key):
         """Paged twin of ``_spec_step_impl``: the block table rides
         along; the host pre-grows each row's chain over its REAL draft
         span (and COW-privatises it), while pad-column writes past the
         chain steer to the null block — so a row near its reservation
         ceiling never allocates for drafts it didn't propose."""
         out, n_acc, cache = self._verify_window(
-            params, cache, tokens, positions, draft_ok, temps, topk,
-            topp, key, block_tables=tables)
+            params, cache, tokens, positions, draft_ok, draft_probs,
+            temps, topk, topp, key, block_tables=tables)
         out = jnp.where(slot_mask[:, None], out,
                         jnp.int32(self.pad_token_id))
         return out, n_acc, cache
 
     def _spec_mixed_step_impl(self, params, cache, tokens, positions,
-                              slot_mask, draft_ok, temps, topk, topp,
-                              cids, cpos, clen, cslot, ctemp, ctopk,
-                              ctopp, key):
+                              slot_mask, draft_ok, draft_probs, temps,
+                              topk, topp, cids, cpos, clen, cslot,
+                              ctemp, ctopk, ctopp, key):
         """Chunked × speculative (contiguous): ``_mixed_step_impl`` with
         the decode half replaced by the verify window.  The chunk half
         is untouched — a prefilling slot is inactive (its spec window
         suspended) until its cursor completes, so the two halves never
         touch the same row."""
         out, n_acc, cache = self._verify_window(
-            params, cache, tokens, positions, draft_ok, temps, topk,
-            topp, key)
+            params, cache, tokens, positions, draft_ok, draft_probs,
+            temps, topk, topp, key)
         out = jnp.where(slot_mask[:, None], out,
                         jnp.int32(self.pad_token_id))
         row = _slot_row(cache, cslot)
@@ -1308,14 +1418,14 @@ class ServingEngine:
 
     def _spec_mixed_step_impl_paged(self, params, cache, tokens,
                                     positions, tables, slot_mask,
-                                    draft_ok, temps, topk, topp, cids,
-                                    cpos, clen, ctable, ctemp, ctopk,
-                                    ctopp, key):
+                                    draft_ok, draft_probs, temps, topk,
+                                    topp, cids, cpos, clen, ctable,
+                                    ctemp, ctopk, ctopp, key):
         """Chunked × speculative (paged): verify window over the pool,
         then the chunk half exactly as ``_mixed_step_impl_paged``."""
         out, n_acc, cache = self._verify_window(
-            params, cache, tokens, positions, draft_ok, temps, topk,
-            topp, key, block_tables=tables)
+            params, cache, tokens, positions, draft_ok, draft_probs,
+            temps, topk, topp, key, block_tables=tables)
         out = jnp.where(slot_mask[:, None], out,
                         jnp.int32(self.pad_token_id))
         with bind_params(self._bind, self._prepare(params)):
@@ -1334,7 +1444,8 @@ class ServingEngine:
                request_uid: Optional[int] = None,
                priority: int = 0,
                ttft_slo_ms: Optional[float] = None,
-               tpot_slo_ms: Optional[float] = None) -> int:
+               tpot_slo_ms: Optional[float] = None,
+               drafter=None) -> int:
         """Enqueue a request; returns its id.  Admission happens inside
         ``step()`` as slots free up (FIFO).
 
@@ -1355,7 +1466,13 @@ class ServingEngine:
         With ``preempt`` armed, the queue admits by priority class
         (stable FIFO within a class) and a blocked admission may evict
         a running lower-priority request — see ``_try_preempt`` for
-        the victim selection contract."""
+        the victim selection contract.
+
+        ``drafter`` overrides the engine's default drafter for THIS
+        request (spec mode): ``"ngram"``, ``"model"``, or a Drafter
+        instance — a router can mix n-gram and draft-model requests in
+        one engine; string kinds are built lazily and memoized, and
+        lifecycle ``spec_accept`` events record ``drafter_kind``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if ttft_slo_ms is None:
             ttft_slo_ms = float(_flags.flag("serving_slo_ttft_ms"))
@@ -1408,7 +1525,7 @@ class ServingEngine:
             t_submit=self._clock(), uid=uid,
             ttft_slo_ms=float(ttft_slo_ms),
             tpot_slo_ms=float(tpot_slo_ms),
-            priority=int(priority)))
+            priority=int(priority), drafter=drafter))
         self._m_submitted.inc()
         return rid
 
@@ -1753,6 +1870,7 @@ class ServingEngine:
             req = entry.req
             # restore the EXACT pre-preemption slot state: mirrors,
             # table row, decode budget, original TTFT clock
+            self._drafter_reset(si)
             self._slots[si] = _Slot(req.request_id, entry.remaining,
                                     t_first=entry.t_first,
                                     prompt=req.prompt, req=req)
@@ -1910,6 +2028,7 @@ class ServingEngine:
         # decode budget; the TPOT clock restarts on this engine's clock
         # (cross-process wall clocks don't compare — BASELINE.md
         # "Multi-host accounting conventions")
+        self._drafter_reset(si)
         self._slots[si] = _Slot(rid, int(record["remaining"]),
                                 t_first=(self._clock()
                                          if record["had_first"] else 0.0),
@@ -2041,48 +2160,89 @@ class ServingEngine:
 
     # -- speculative-decode scheduler (verify steps) -----------------------
 
-    def _propose_drafts(self) -> Tuple[np.ndarray, np.ndarray]:
-        """The host draft phase: ask the n-gram self-drafter for up to
-        ``spec_k`` tokens per GREEDY active slot (sampled rows decode
-        plain — their distribution stays exact), capped so an accepted
-        window can never overrun the row's token budget
-        (``remaining - 1`` drafts ⇒ at most ``remaining`` commits) or
-        ``max_length - 1`` (every window write stays in bounds).
-        Returns the (num_slots, k) draft matrix (pad-filled) and the
-        bool real-proposal mask."""
+    def _propose_drafts(self) -> Tuple[np.ndarray, np.ndarray,
+                                       np.ndarray]:
+        """The draft phase: ask each slot's drafter (engine default or
+        the request's ``submit(drafter=...)`` override) for up to
+        ``spec_k`` tokens, capped so an accepted window can never
+        overrun the row's token budget (``remaining - 1`` drafts ⇒ at
+        most ``remaining`` commits) or ``max_length - 1`` (every window
+        write stays in bounds).
+
+        Host proposers (n-gram and injected scripted drafters) run per
+        slot and carry ONE-HOT proposal distributions — deterministic
+        q, so sampled rows accept draft d w.p. p_target(d) and greedy
+        rows keep the exact prefix-match rule.  Device proposers (the
+        draft model) run ONE batched draft step per tick across all
+        their slots and return the true proposal softmax q.  Returns
+        the (num_slots, k) draft matrix (pad-filled), the bool
+        real-proposal mask, and the (num_slots, k, vocab) f32 q stack
+        (all-zero rows at non-proposed columns — the acceptance treats
+        those residuals as the plain target distribution)."""
         s, k = self.num_slots, self.spec_k
+        vocab = self.config.vocab_size
         drafts = np.full((s, k), self.pad_token_id, np.int32)
         ok = np.zeros((s, k), bool)
+        probs = np.zeros((s, k, vocab), np.float32)
+        kinds: List[Optional[str]] = [None] * s
+        caps = np.zeros((s,), np.int32)
+        device_jobs: Dict[int, Dict[int, np.ndarray]] = {}
+        device_objs: Dict[int, object] = {}
         for i, slot in enumerate(self._slots):
-            if slot is None or self._temps[i] > 0.0:
+            if slot is None:
+                continue
+            d = self._drafter_for(slot.req.drafter
+                                  if slot.req is not None else None)
+            if d is None:
                 continue
             cap = min(k, slot.remaining - 1,
                       self.max_length - 1 - int(self._positions[i]))
             if cap < 1:
                 continue
+            caps[i] = cap
+            kinds[i] = str(getattr(d, "kind", "custom"))
             hist = np.concatenate(
                 [slot.prompt,
                  np.asarray(self._results[slot.rid], np.int32)])
-            prop = self._drafter.propose(hist)[:cap]
+            if getattr(d, "uses_device", False):
+                # batch every draft-model row into one device step
+                device_objs.setdefault(id(d), d)
+                device_jobs.setdefault(id(d), {})[i] = hist
+                continue
+            prop = np.asarray(d.propose(hist), np.int32)[:cap]
             if prop.size:
-                drafts[i, :prop.size] = prop
-                ok[i, :prop.size] = True
-                self._m_drafted.inc(int(prop.size))
-        return drafts, ok
+                m = int(prop.size)
+                drafts[i, :m] = prop
+                ok[i, :m] = True
+                probs[i, np.arange(m), prop] = 1.0
+                self._m_drafted.inc(m)
+                self._spec_m(kinds[i])[0].inc(m)
+        for did, rows in device_jobs.items():
+            dd, dp = device_objs[did].propose_batch(
+                rows, self._temps, seed=self._ticks)
+            for i in rows:
+                m = int(caps[i])
+                drafts[i, :m] = dd[i, :m]
+                ok[i, :m] = True
+                probs[i, :m] = dp[i, :m]
+                self._m_drafted.inc(m)
+                self._spec_m(kinds[i])[0].inc(m)
+        self._tick_drafter_kind = kinds
+        return drafts, ok, probs
 
     def _step_inner_spec(self) -> List[int]:
-        """One speculative tick: wave admission unchanged, then draft on
-        the host and run ONE verify step over every slot's (k+1)-token
-        window.  Each row commits 1..k+1 tokens; the weight stream —
-        the b=1 bound BENCH_DECODE.json proves — is paid once either
-        way."""
+        """One speculative tick: wave admission unchanged, then draft
+        (host n-gram per slot, or ONE batched draft-model step) and run
+        ONE verify step over every slot's (k+1)-token window.  Each row
+        commits 1..k+1 tokens; the weight stream — the b=1 bound
+        BENCH_DECODE.json proves — is paid once either way."""
         finished = self._admit()
         occ = int(self._active.sum())
         self._set_occupancy(occ)
         if not occ:
             return finished
         with self._tracer.span("serving.draft"):
-            drafts, draft_ok = self._propose_drafts()
+            drafts, draft_ok, draft_probs = self._propose_drafts()
         window = np.concatenate([self._tokens[:, None], drafts], axis=1)
         self._ticks += 1
         key = jax.random.fold_in(self._base_key, self._ticks)
@@ -2105,6 +2265,7 @@ class ServingEngine:
                     self._params, self._cache, jnp.asarray(window),
                     jnp.asarray(self._positions), jnp.asarray(self._tables),
                     jnp.asarray(self._active), jnp.asarray(draft_ok),
+                    jnp.asarray(draft_probs),
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), key)
             else:
@@ -2112,6 +2273,7 @@ class ServingEngine:
                     self._params, self._cache, jnp.asarray(window),
                     jnp.asarray(self._positions),
                     jnp.asarray(self._active), jnp.asarray(draft_ok),
+                    jnp.asarray(draft_probs),
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), key)
             out, n_acc = jax.device_get((out, n_acc))  # the one host sync
@@ -2132,11 +2294,14 @@ class ServingEngine:
         accepted-per-step observation, ONE retirement, and TPOT stays a
         per-request retirement-time readout (never per-token)."""
         finished: List[int] = []
+        kinds = getattr(self, "_tick_drafter_kind", [None] * len(out))
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
             n = int(n_acc[i])
             drafted = int(draft_ok[i].sum())
+            kind = kinds[i] if i < len(kinds) else None
+            km = self._spec_m(kind) if (drafted and kind) else None
             take, reason = n, None
             if self.eos_token_id is not None:
                 hits = np.where(out[i, :n] == self.eos_token_id)[0]
@@ -2149,10 +2314,13 @@ class ServingEngine:
             slot.remaining -= take
             self._m_tokens.inc(take)
             self._m_spec_accept.observe(take)
+            if km is not None:
+                km[4].observe(take)
             if drafted and slot.req is not None:
                 self._rlog.event(slot.req.uid, "spec_accept",
                                  engine=self._eid, tokens=int(take),
-                                 drafted=int(drafted))
+                                 drafted=int(drafted),
+                                 drafter_kind=kind or "custom")
             if drafted:
                 # hits = committed draft tokens (the bonus token is free
                 # either way); misses = drafts verification rejected —
@@ -2160,12 +2328,17 @@ class ServingEngine:
                 # them on either side
                 self._m_draft_hits.inc(take - 1)
                 self._m_draft_miss.inc(drafted - (n - 1))
+                if km is not None:
+                    km[1].inc(take - 1)
+                    km[2].inc(drafted - (n - 1))
             if take <= drafted:
                 # the row wrote K/V past its accept point: pin the
                 # position (contiguous rollback is exactly that — the
                 # stale cells above it are rewritten before any mask
                 # reads them) and, paged, return draft-only blocks
                 self._m_rollbacks.inc()
+                if km is not None:
+                    km[3].inc()
                 if self.paged:
                     self.kv.truncate_to(i, int(self._positions[i]))
                     self._tables[i] = self.kv.table_row(i,
@@ -2223,7 +2396,7 @@ class ServingEngine:
             # A prefilling slot is inactive until its cursor completes,
             # so its spec window is suspended by construction.
             with self._tracer.span("serving.draft"):
-                drafts, draft_ok = self._propose_drafts()
+                drafts, draft_ok, draft_probs = self._propose_drafts()
             window = np.concatenate([self._tokens[:, None], drafts],
                                     axis=1)
         t0 = self._clock()
@@ -2255,7 +2428,8 @@ class ServingEngine:
                 self._flush_fresh_scales()
                 head = ((jnp.asarray(window), jnp.asarray(self._positions),
                          jnp.asarray(self._tables),
-                         jnp.asarray(self._active), jnp.asarray(draft_ok))
+                         jnp.asarray(self._active), jnp.asarray(draft_ok),
+                         jnp.asarray(draft_probs))
                         if self.spec else
                         (jnp.asarray(self._tokens),
                          jnp.asarray(self._positions),
@@ -2275,7 +2449,8 @@ class ServingEngine:
                 dev_pos = np.where(self._active, self._positions,
                                    self.max_length).astype(np.int32)
                 head = ((jnp.asarray(window), jnp.asarray(dev_pos),
-                         jnp.asarray(self._active), jnp.asarray(draft_ok))
+                         jnp.asarray(self._active), jnp.asarray(draft_ok),
+                         jnp.asarray(draft_probs))
                         if self.spec else
                         (jnp.asarray(self._tokens), jnp.asarray(dev_pos),
                          jnp.asarray(self._active)))
@@ -2402,6 +2577,7 @@ class ServingEngine:
             first = ctok
             slot = _Slot(req.request_id, req.max_new_tokens - 1,
                          t_first=now, prompt=req.prompt, req=req)
+        self._drafter_reset(si)
         self._slots[si] = slot
         self._active[si] = True
         self._tokens[si] = first
@@ -2508,10 +2684,13 @@ class ServingEngine:
         topp = jnp.ones((s,), jnp.float32)
         key = jax.random.fold_in(self._base_key, 0)
         if self.spec:
-            # the verify step's window matrix + real-proposal mask ride
-            # in place of the (s,) token vector
+            # the verify step's window matrix + real-proposal mask +
+            # proposal-distribution stack ride in place of the (s,)
+            # token vector
             head = (jnp.zeros((s, self.spec_k + 1), jnp.int32), pos)
-            tail_mask = (mask, jnp.zeros((s, self.spec_k), bool))
+            tail_mask = (mask, jnp.zeros((s, self.spec_k), bool),
+                         jnp.zeros((s, self.spec_k,
+                                    self.config.vocab_size), jnp.float32))
         else:
             head, tail_mask = (toks, pos), (mask,)
         if self.chunked:
@@ -2576,12 +2755,24 @@ class ServingEngine:
         rounded up to one lane tile, cache length up to
         FLAGS_decode_attention_min_len, paged block_len up to 128.
         A 'mixed' pool keeps bf16 device blocks (only 'int8' changes
-        program shapes), so mixed engines get the bf16 specs."""
+        program shapes), so mixed engines get the bf16 specs.
+
+        On a model-parallel mesh the kernel runs PER SHARD under
+        shard_map — kv-heads are mp-sharded — so the pre-flighted
+        geometry divides both head counts by the mp degree (that is
+        the program each device actually compiles; whole-model heads
+        would overstate VMEM by mp×)."""
         from .. import static_analysis as _sa
         lanes = 128
         c = self.config
         hkv = int(c.num_key_value_heads)
         hq = int(c.num_attention_heads)
+        mp = (dict(getattr(self.mesh, "shape", {})).get("mp", 1)
+              if self.mesh is not None else 1)
+        shard = ""
+        if mp > 1 and hq % mp == 0 and hkv % mp == 0:
+            hq, hkv = hq // mp, hkv // mp
+            shard = f",mp{mp}-shard"
         d_p = max(lanes, -(-int(c.head_dim) // lanes) * lanes)
         min_len = int(_flags.flag("decode_attention_min_len"))
         quantized = self.quantized
@@ -2595,7 +2786,7 @@ class ServingEngine:
         specs = []
         for b, s, label in shapes:
             tag = (f"{layout}{'+int8' if quantized else ''},"
-                   f"{label},s={s}")
+                   f"{label},s={s}{shard}")
             if self.paged:
                 bl_p = max(lanes, -(-self.block_len // lanes) * lanes)
                 mb_p = max(self.max_blocks, -(-min_len // bl_p))
@@ -2904,6 +3095,8 @@ class ServingEngine:
                     self._m_spec_accept.sum / acc["count"], 3)
             out["spec"] = {
                 "spec_k": self.spec_k,
+                "default_drafter": getattr(self._drafter, "kind",
+                                           "custom"),
                 "drafted_tokens": drafted,
                 "draft_hit_tokens": hits,
                 "draft_miss_tokens": int(self._m_draft_miss.value()),
@@ -2911,6 +3104,26 @@ class ServingEngine:
                                    else 0.0),
                 "rollbacks": int(self._m_rollbacks.value()),
                 "accepted_per_step": acc}
+            by_drafter = {}
+            for kind, (md, mh, mm, mr, ma) in sorted(
+                    self._spec_children.items()):
+                kd, kh = int(md.value()), int(mh.value())
+                kacc = hist(ma)
+                if kacc["count"]:
+                    kacc["mean"] = round(ma.sum / kacc["count"], 3)
+                by_drafter[kind] = {
+                    "drafted_tokens": kd,
+                    "draft_hit_tokens": kh,
+                    "draft_miss_tokens": int(mm.value()),
+                    # per-kind denominator: THAT drafter's proposals
+                    # only (BASELINE.md "Rejection-sampling accounting
+                    # conventions")
+                    "draft_hit_rate": (round(kh / kd, 3) if kd
+                                       else 0.0),
+                    "rollbacks": int(mr.value()),
+                    "accepted_per_step": kacc}
+            if by_drafter:
+                out["spec"]["by_drafter"] = by_drafter
         if self.paged:
             st = self.kv.stats
             total = self.prefill_tokens_total
@@ -3187,6 +3400,7 @@ class ServingEngine:
                 first = int(tok[r])
                 slot = _Slot(req.request_id, req.max_new_tokens - 1,
                              t_first=t_tok, prompt=req.prompt, req=req)
+            self._drafter_reset(si)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = first
@@ -3263,6 +3477,7 @@ class ServingEngine:
         for r, (req, si) in enumerate(zip(wave, slots)):
             slot = _Slot(req.request_id, req.max_new_tokens - 1,
                          t_first=t_tok, prompt=req.prompt, req=req)
+            self._drafter_reset(si)
             self._slots[si] = slot
             self._active[si] = True
             self._tokens[si] = tok[r]
@@ -3307,6 +3522,7 @@ class ServingEngine:
         ``kv.release`` for normal retirement."""
         if self.paged:
             self._tables[i] = 0
+        self._drafter_reset(i)
         self._slots[i] = None
         self._active[i] = False
         self._tokens[i] = self.pad_token_id

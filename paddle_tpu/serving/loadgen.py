@@ -369,6 +369,7 @@ def _smoke() -> int:
     summary["fleet_sim"] = _smoke_fleet_sim(model, load, failures)
     summary["multihost"] = _smoke_multihost(model, load, failures)
     summary["federated"] = _smoke_federated(model, load, failures)
+    summary["spec_model"] = _smoke_spec_model(model, load, failures)
     summary["failures"] = failures
     print(json.dumps(summary, indent=2))
     return 1 if failures else 0
@@ -733,6 +734,110 @@ def _smoke_federated(model, load: Sequence[LoadRequest],
     out.update(a)
     out["signature_stable"] = a["signature"] == b["signature"]
     return out
+
+
+def _smoke_spec_model(model, load: Sequence[LoadRequest],
+                      failures: List[str]) -> Dict[str, Any]:
+    """ISSUE 20 CI gates for draft-model speculation: the trace replayed
+    twice through a 2-replica loopback plane running MIXED drafters
+    (replica w0 a truncated draft model, w1 the n-gram drafter) must
+    keep BOTH once-jitted budgets (verify step and draft step, 1 trace
+    each), replay byte-stable (timeline signature and sampled outputs),
+    lint clean, and the per-shard kernel geometry a model-parallel
+    engine would pre-flight (heads/mp, the ``mpN-shard`` variant) must
+    be finding-free — all device-free except the tiny CPU replay."""
+    from collections import OrderedDict
+
+    from .. import static_analysis as _sa
+    from ..models.llama import draft_model_from
+    from .engine import ServingEngine
+    from .multihost import EngineWorker, LoopbackTransport, MultiHostRouter
+
+    # fresh registry: this leg builds its own engines and reads their
+    # trace budgets; collapsed {overflow} children from the modes above
+    # would merge counters across engines (same reasoning as multihost)
+    _obs.reset()
+    dm, dparams = draft_model_from(model, num_layers=1)
+
+    def mk_plane():
+        workers = OrderedDict()
+        engines = []
+        for name, kw in (("w0", {"drafter": "model",
+                                 "draft_model": (dm, dparams)}),
+                         ("w1", {"drafter": "ngram"})):
+            eng = ServingEngine(model, num_slots=4, max_length=128,
+                                prefill_batch=2, spec_decode=True,
+                                spec_k=3, **kw)
+            engines.append(eng)
+            w = EngineWorker(eng, name=name)
+            workers[name] = LoopbackTransport(w.handle, name=name)
+        return MultiHostRouter(workers, policy="prefix"), engines
+
+    runs = []
+    lint_findings = -1
+    draft_traces = 0
+    drafted: Dict[str, int] = {}
+    for _ in range(2):
+        plane, engines = mk_plane()
+        if lint_findings < 0:
+            kf = [f for e in engines for f in e.lint_step()]
+            lint_findings = len(kf)
+            if kf:
+                failures.append("spec_model: lint findings: "
+                                + "; ".join(str(f) for f in kf))
+        runs.append(replay(plane, load))
+        for e in engines:
+            by = e.metrics().get("spec", {}).get("by_drafter", {})
+            for kind, m in by.items():
+                drafted[kind] = (drafted.get(kind, 0)
+                                 + m["drafted_tokens"])
+            d = e._drafter
+            if getattr(d, "uses_device", False):
+                draft_traces = max(draft_traces, d.draft_traces)
+    a, b = runs
+    traces = max(max(r["step_traces"]) for r in runs)
+    if traces > 1:
+        failures.append(f"spec_model: verify step retraced "
+                        f"(traces={traces})")
+    if draft_traces > 1:
+        failures.append(f"spec_model: draft step retraced "
+                        f"(traces={draft_traces})")
+    if a["signature"] != b["signature"]:
+        failures.append("spec_model: timeline signature drift between "
+                        "identical-seed runs")
+    if a["outputs"] != b["outputs"]:
+        failures.append("spec_model: sampled-output drift between "
+                        "identical-seed runs")
+    if drafted.get("model", 0) <= 0:
+        failures.append("spec_model: the draft-model replica proposed "
+                        "nothing — the mode is not exercising the "
+                        "drafter")
+    # per-shard pre-flight: the exact geometry a mesh (mp=2) engine's
+    # _kernel_specs projects — heads/mp, head_dim and cache length
+    # rounded to kernel tiles — must lint clean (static, no devices)
+    c = model.config
+    mp = 2
+    hq = max(int(c.num_attention_heads) // mp, 1)
+    hkv = max(int(c.num_key_value_heads) // mp, 1)
+    shard_spec = _sa.decode_attention_spec(
+        4, 4, hq, hkv, 128, kv_len=4096,
+        variant=f"contiguous,spec_verify,s=4,mp{mp}-shard")
+    shard_findings = _sa.analyze_kernels([shard_spec])
+    if shard_findings:
+        failures.append("spec_model: per-shard kernel pre-flight "
+                        "findings: "
+                        + "; ".join(str(f) for f in shard_findings))
+    return {
+        "ticks": a["ticks"],
+        "generated_tokens": a["generated_tokens"],
+        "step_traces": traces,
+        "draft_step_traces": draft_traces,
+        "lint_findings": lint_findings,
+        "kernel_findings": len(shard_findings),
+        "drafted_tokens_by_kind": dict(sorted(drafted.items())),
+        "deterministic": (a["signature"] == b["signature"]
+                          and a["outputs"] == b["outputs"]),
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -316,7 +316,14 @@ def decode_attention_spec(b: int, s: int, hq: int, hkv: int, d: int, *,
     init_kv_cache layout).  Alignment/granule violations are RECORDED
     in ``dims`` for the rules to flag (the kernel would raise at call
     time; the pre-flight's job is to say so beforehand) — only shapes
-    with no expressible geometry raise :class:`KernelSpecError`."""
+    with no expressible geometry raise :class:`KernelSpecError`.
+
+    Mesh-sharded callers (the shard_map fast path) must pass PER-SHARD
+    geometry — ``hq/mp`` and ``hkv/mp`` heads — and tag ``variant``
+    with an ``mpN-shard`` suffix: under ``shard_map`` each shard runs
+    its own kernel instance, so whole-model head counts would overstate
+    VMEM by the mp degree (BASELINE.md "Rejection-sampling accounting
+    conventions")."""
     if hkv == 0 or hq % hkv:
         raise KernelSpecError(
             f"q heads ({hq}) must be a multiple of kv heads ({hkv})")

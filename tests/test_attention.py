@@ -329,3 +329,59 @@ class TestCachedDecodeAttention:
                                        pos - 4)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
+
+
+# -- structured fallback-reason kinds (ISSUE 20 satellite) --------------------
+
+def test_fallback_reason_kinds_warn_contract(monkeypatch):
+    """Every demotion carries a machine-readable ``kind``; only
+    feature/shape/kernel kinds — genuine perf surprises — warn, while
+    backend/mesh/policy demotions are the design and stay silent (the
+    contract at the top of ops/attention.py)."""
+    from paddle_tpu.ops import attention
+    from paddle_tpu.ops.attention import cached_decode_attention
+    from paddle_tpu.utils import get_logger
+    from paddle_tpu.utils import logging as ptlog
+
+    records = []
+    monkeypatch.setattr(get_logger(), "info",
+                        lambda msg, *a: records.append(msg % a))
+    monkeypatch.setenv("GLOG_v", "1")
+    monkeypatch.setattr(ptlog, "_vlog_once_seen", set())
+
+    # classification, at the decision layer (no arrays needed)
+    monkeypatch.setattr(attention._dispatch, "use_pallas", lambda: False)
+    _, r = attention._decode_attention_decision(1, 1, 8, 2, 64, 8192,
+                                                False, None)
+    assert attention.reason_kind(r) == attention.KIND_BACKEND
+
+    monkeypatch.setattr(attention._dispatch, "use_pallas", lambda: True)
+    _, r = attention._decode_attention_decision(1, 1, 8, 2, 64, 8192,
+                                                True, None)     # extra_mask
+    assert attention.reason_kind(r) == attention.KIND_POLICY
+    _, r = attention._decode_attention_decision(1, 1, 8, 2, 64, 256,
+                                                False, None)    # min_len
+    assert attention.reason_kind(r) == attention.KIND_POLICY
+    _, r = attention._decode_attention_decision(1, 1, 8, 2, 512, 8192,
+                                                False, None)    # head_dim
+    assert attention.reason_kind(r) == attention.KIND_SHAPE
+    # a FallbackReason is still a str — text matching keeps working
+    assert isinstance(r, str) and "head_dim" in r
+    assert attention.WARN_KINDS == frozenset(
+        {attention.KIND_FEATURE, attention.KIND_SHAPE,
+         attention.KIND_KERNEL})
+
+    # behaviour: a POLICY demotion (short cache) is silent...
+    q = jnp.asarray(_rand((1, 1, 8, 16), 91))
+    kc = jnp.asarray(_rand((1, 256, 2, 16), 92))
+    vc = jnp.asarray(_rand((1, 256, 2, 16), 93))
+    cached_decode_attention(q, kc, vc, 5)
+    assert not [m for m in records if "falling back" in m]
+    # ...while a SHAPE demotion (kernel-depth cache, max_length not
+    # 128-aligned) warns, exactly once across repeats
+    kc2 = jnp.asarray(_rand((1, 4160, 2, 16), 94))
+    vc2 = jnp.asarray(_rand((1, 4160, 2, 16), 95))
+    cached_decode_attention(q, kc2, vc2, 5)
+    cached_decode_attention(q, kc2, vc2, 5)
+    hits = [m for m in records if "falling back" in m]
+    assert len(hits) == 1 and "128-aligned" in hits[0]

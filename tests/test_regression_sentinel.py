@@ -75,7 +75,7 @@ def test_check_history_green_on_committed_repo():
     names = {c["name"] for c in r["checks"]}
     assert {"bench_r_mfu_trajectory", "int8_streamed_bytes_ratio",
             "step_traces_budget", "decode_head_tok_s",
-            "perf_model_row"} <= names
+            "perf_model_row", "spec_model_row"} <= names
     assert all(c["ok"] is not False for c in r["checks"])
 
 
@@ -130,6 +130,34 @@ def test_synthetic_retrace_regression_fails(tmp_path):
     assert r["ok"] is False
     bad = {c["name"]: c["ok"] for c in r["checks"]}
     assert bad["step_traces_budget"] is False
+
+
+def test_synthetic_spec_model_regression_fails(tmp_path):
+    root = _copy_artifacts(tmp_path)
+
+    def lose_the_win(b):
+        row = b["cpu_plumbing_smoke"]["spec_model"]
+        row["model_beats_ngram_on_novel"] = False
+
+    _edit(os.path.join(root, "BENCH_DECODE.json"), lose_the_win)
+    r = check_history(root)
+    assert r["ok"] is False
+    bad = {c["name"]: c["ok"] for c in r["checks"]}
+    assert bad["spec_model_row"] is False
+
+
+def test_synthetic_spec_model_mesh_demotion_fails(tmp_path):
+    root = _copy_artifacts(tmp_path)
+
+    def demote(b):
+        for row in b["cpu_plumbing_smoke"]["spec_model"]["mesh_paths"]:
+            row["chosen_path"] = "xla_math"
+
+    _edit(os.path.join(root, "BENCH_DECODE.json"), demote)
+    r = check_history(root)
+    assert r["ok"] is False
+    bad = {c["name"]: c["ok"] for c in r["checks"]}
+    assert bad["spec_model_row"] is False
 
 
 def test_missing_artifacts_skip_rather_than_fail(tmp_path):

@@ -557,3 +557,30 @@ def test_per_row_position_decode_matches_scalar(lm):
         np.testing.assert_allclose(np.asarray(vec_cache[:, :, r]),
                                    np.asarray(crow[:, :, 0]),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_draft_model_from_truncates_and_aliases(lm):
+    """ISSUE 20: the layer-truncated draft model is ZERO-COPY — every
+    shared parameter is the target's own array object, the architecture
+    keeps the target's vocab/embedding geometry (rejection sampling
+    needs q on p's support), and the depth is actually truncated."""
+    from paddle_tpu.models import draft_model_from
+
+    dm, dparams = draft_model_from(lm, num_layers=1)
+    assert dm.config.num_hidden_layers == 1
+    assert dm.config.vocab_size == lm.config.vocab_size
+    assert dm.config.hidden_size == lm.config.hidden_size
+
+    src = lm.state_dict(include_buffers=True)
+    shared = [k for k in dparams if k in src]
+    assert shared and all(dparams[k] is src[k] for k in shared)
+    # nothing invented: every draft param either aliases the target's
+    # or belongs to the draft skeleton itself
+    own = dm.state_dict(include_buffers=True)
+    assert set(dparams) == set(own)
+
+    with pytest.raises(ValueError):
+        draft_model_from(lm, num_layers=0)
+    with pytest.raises(ValueError):
+        draft_model_from(
+            lm, num_layers=lm.config.num_hidden_layers + 1)

@@ -16,6 +16,7 @@ structured placement-drift finding).
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 import pytest
 
 import paddle_tpu as pt
@@ -100,11 +101,13 @@ def test_resolve_mesh_forms():
 
 
 def test_dispatch_gates_pallas_under_mesh():
-    """The flash-decode dispatch rule: a shape the Pallas kernel would
-    take single-chip routes to the XLA gather path inside a
-    mesh-sharded trace (Pallas-under-shard_map is not wired; a bare
-    pallas_call would make GSPMD replicate its operands)."""
+    """The flash-decode dispatch rule under a mesh (ISSUE 20): an
+    ELIGIBLE mesh-sharded decode shape routes to the shard_map-wrapped
+    per-shard kernel (``pallas_decode_shard_map``); an ineligible one
+    (rows not divisible over dp×sharding) still demotes to the XLA
+    gather path with a structured mesh-kind reason."""
     from paddle_tpu.distributed import env as denv
+    from paddle_tpu.ops import attention
     from paddle_tpu.ops.attention import decode_attention_path
 
     old = flags_mod.flag("pallas_interpret")
@@ -114,8 +117,14 @@ def test_dispatch_gates_pallas_under_mesh():
         assert path == "pallas_decode"
         mesh = ServingEngine._resolve_mesh("mp2dp2")
         with denv.use_mesh(mesh):
+            # b=1 can't split over dp*sharding=2: demote, mesh kind
             path, reason = decode_attention_path(1, 1, 8, 2, 64, 8192)
-        assert path == "xla_math" and "mesh-sharded" in reason
+            assert path == "xla_math" and "mesh-sharded" in reason
+            assert attention.reason_kind(reason) == attention.KIND_MESH
+            # b=4 splits evenly, heads divide mp, per-shard shape fits:
+            # the mesh fast path
+            path, reason = decode_attention_path(4, 1, 8, 2, 64, 8192)
+            assert path == "pallas_decode_shard_map" and reason is None
         # an all-ones mesh is single-chip: no gate
         import paddle_tpu.distributed as dist
         one = dist.HybridCommunicateGroup(devices=jax.devices()[:1]).mesh
@@ -124,6 +133,59 @@ def test_dispatch_gates_pallas_under_mesh():
         assert path == "pallas_decode"
     finally:
         flags_mod.set_flags({"pallas_interpret": old})
+
+
+def test_shard_map_decode_parity_and_routing():
+    """ISSUE 20 acceptance (interpret tier): the shard_map fast path
+    numerically matches the XLA gather reference at mp2dp2 on the
+    virtual CPU devices — contiguous and paged — and the trace counts
+    a ``pallas_decode_shard_map`` kernel_path row (outer dispatch) plus
+    per-shard ``pallas_decode`` rows (the body's re-dispatch at
+    Hkv/mp-head geometry)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.ops.attention import (cached_decode_attention,
+                                          cached_decode_attention_reference)
+
+    b, s, hq, hkv, d, kv_len, bl = 4, 1, 8, 2, 64, 8192, 128
+    rs = np.random.RandomState(17)
+    q = jnp.asarray(rs.normal(size=(b, s, hq, d)).astype(np.float32))
+    kc = jnp.asarray(rs.normal(size=(b, kv_len, hkv, d)).astype(np.float32))
+    vc = jnp.asarray(rs.normal(size=(b, kv_len, hkv, d)).astype(np.float32))
+    pos = jnp.asarray([37, 513, 129, 1025], jnp.int32)
+    n_blocks = kv_len // bl
+    pool_k = jnp.reshape(kc, (b * n_blocks, bl, hkv, d))
+    pool_v = jnp.reshape(vc, (b * n_blocks, bl, hkv, d))
+    tables = jnp.reshape(jnp.arange(b * n_blocks, dtype=jnp.int32),
+                         (b, n_blocks))
+    reg = obs.default_registry()
+    fam = reg.get("ops.kernel_path")
+    before = (fam.value(op="decode_attention",
+                        path="pallas_decode_shard_map", cache="contiguous")
+              if fam is not None else 0)
+    old = flags_mod.flag("pallas_interpret")
+    flags_mod.set_flags({"pallas_interpret": True})
+    try:
+        mesh = ServingEngine._resolve_mesh("mp2dp2")
+        with denv.use_mesh(mesh):
+            got = cached_decode_attention(q, kc, vc, pos)
+            got_paged = cached_decode_attention(q, pool_k, pool_v, pos,
+                                                block_tables=tables)
+    finally:
+        flags_mod.set_flags({"pallas_interpret": old})
+    want = cached_decode_attention_reference(q, kc, vc, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_paged), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    fam = reg.get("ops.kernel_path")
+    assert fam.value(op="decode_attention", path="pallas_decode_shard_map",
+                     cache="contiguous") >= before + 1
+    assert fam.value(op="decode_attention", path="pallas_decode_shard_map",
+                     cache="paged") >= 1
+    # the per-shard re-dispatch inside the body took the kernel
+    assert fam.value(op="decode_attention", path="pallas_decode",
+                     cache="contiguous") >= 1
 
 
 # -- heavy parity sweep + CLI execute (slow lane) ---------------------------

@@ -1,0 +1,219 @@
+"""Rejection-sampling speculative decoding (ISSUE 20).
+
+Two gold standards, mirroring Leviathan et al. 2023 Thm 1:
+
+* **Distribution exactness** — with ``draft_probs`` given, the committed
+  token stream of ``accept_draft_tokens`` must be distributed EXACTLY as
+  plain sampling from the target, whatever proposal q the drafter used.
+  Verified by seeded chi-square on the first committed column (its
+  marginal is the position-0 target p regardless of q), at k=1 and k=4,
+  including an adversarial q that forces the all-rejected residual
+  resample branch on almost every row.
+* **Greedy parity** — greedy rows keep the exact argmax-match rule, so
+  a spec engine driving a truncated draft model commits streams
+  token-identical to plain decode in every layout (wave/chunked ×
+  contiguous/paged).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import (LlamaForCausalLM, accept_draft_tokens,
+                               draft_model_from, tiny_llama_config)
+from paddle_tpu.models.generation import _target_probs
+from paddle_tpu.serving import ServingEngine
+
+MAXLEN = 64
+V = 8                      # small vocab -> well-populated chi-square bins
+# df = V-1 = 7; crit at alpha=0.01 is 18.48.  Seeded draws make the
+# statistic deterministic, so a pass here is a regression pin, not luck.
+CHI2_CRIT = 18.48
+
+
+def _logit_table(s, seed=0):
+    """Fixed (S, V) logit rows with distinct, non-degenerate targets."""
+    return jnp.asarray(
+        np.random.RandomState(seed).uniform(-2.0, 2.0, (s, V)), jnp.float32)
+
+
+def _chi2(counts, p):
+    exp = p * counts.sum()
+    return float(((counts - exp) ** 2 / np.maximum(exp, 1e-9)).sum())
+
+
+def _first_token_counts(rows_logits, q, drafts=None, n_rows=4096, seed=0,
+                        temperature=1.0):
+    """Empirical histogram of the FIRST committed token over ``n_rows``
+    i.i.d. replays, batched as rows of one traced call (independence
+    comes from batching the B axis of the uniform/categorical draws).
+
+    ``drafts=None`` samples each row's draft column j from q_j — the
+    premise of Leviathan Thm 1 (the committed marginal is only the
+    target p when d ~ q).  Explicit drafts are for masked/pad columns
+    whose q carries no sampleable mass."""
+    s = rows_logits.shape[0]
+    q = jnp.reshape(q, (s - 1, V))
+    if drafts is None:
+        qn = np.asarray(q, np.float64)
+        qn = qn / qn.sum(-1, keepdims=True)
+        rs = np.random.RandomState(seed ^ 0xd12a)
+        db = jnp.asarray(np.stack(
+            [rs.choice(V, size=n_rows, p=qn[j]) for j in range(s - 1)],
+            axis=1), jnp.int32)                        # (n_rows, S-1)
+    else:
+        drafts = jnp.reshape(drafts, (s - 1,))
+        db = jnp.broadcast_to(drafts[None],
+                              (n_rows, s - 1)).astype(jnp.int32)
+    logits = jnp.broadcast_to(rows_logits[None], (n_rows, s, V))
+    qb = jnp.broadcast_to(q[None], (n_rows, s - 1, V))
+    mask = qb.sum(-1) > 0
+    temps = jnp.full((n_rows,), temperature, jnp.float32)
+    toks, n = accept_draft_tokens(
+        logits, db, mask, jax.random.PRNGKey(seed), temperature=temps,
+        draft_probs=qb)
+    first = np.asarray(toks[:, 0])
+    return np.bincount(first, minlength=V).astype(np.float64), np.asarray(n)
+
+
+def test_chi_square_k1_matches_target():
+    """k=1: committed first token ~ target p exactly, q != p."""
+    tbl = _logit_table(2, seed=3)
+    q = jnp.asarray(np.random.RandomState(7).dirichlet(
+        np.ones(V), size=1), jnp.float32)            # (1, V), far from p
+    p = np.asarray(_target_probs(tbl[None, :1], jnp.ones((1,))))[0, 0]
+    counts, _ = _first_token_counts(tbl, q, seed=11)
+    assert _chi2(counts, p) < CHI2_CRIT
+
+
+def test_chi_square_k4_matches_target():
+    """k=4: the first committed column's marginal is still position-0's
+    target p — acceptance depth varies, the distribution must not."""
+    tbl = _logit_table(5, seed=5)
+    rs = np.random.RandomState(13)
+    q = jnp.asarray(rs.dirichlet(np.ones(V), size=4), jnp.float32)
+    p = np.asarray(_target_probs(tbl[None, :1], jnp.ones((1,))))[0, 0]
+    counts, n = _first_token_counts(tbl, q, seed=17)
+    assert _chi2(counts, p) < CHI2_CRIT
+    # acceptance depth actually varies (speculation is live, not
+    # degenerate accept-none/accept-all)
+    assert len(np.unique(n)) > 1
+
+
+def test_chi_square_all_rejected_resample_branch():
+    """Adversarial q: one-hot on the LOWEST-p token, so acceptance
+    probability is min(1, p_min/1) and nearly every row takes the
+    residual-resample branch — which must still reproduce p exactly."""
+    tbl = _logit_table(2, seed=9)
+    p = np.asarray(_target_probs(tbl[None, :1], jnp.ones((1,))))[0, 0]
+    worst = int(np.argmin(p))
+    q = jnp.zeros((1, V), jnp.float32).at[0, worst].set(1.0)
+    drafts = jnp.asarray([[worst]], jnp.int32)
+    counts, n = _first_token_counts(tbl, q, drafts, seed=23)
+    assert _chi2(counts, p) < CHI2_CRIT
+    # the branch under test dominated: most rows rejected the draft
+    assert float((n == 1).mean()) > 0.5
+
+
+def test_pad_column_all_zero_q_is_plain_sample():
+    """Convention pin: a column the drafter skipped (all-zero q row,
+    draft_mask False) commits an ordinary target sample — residual
+    falls back to p, the draft can never be 'verified'."""
+    tbl = _logit_table(2, seed=15)
+    p = np.asarray(_target_probs(tbl[None, :1], jnp.ones((1,))))[0, 0]
+    q = jnp.zeros((1, V), jnp.float32)
+    drafts = jnp.asarray([[int(np.argmax(p))]], jnp.int32)
+    counts, n = _first_token_counts(tbl, q, drafts, seed=29)
+    assert _chi2(counts, p) < CHI2_CRIT
+    assert int(n.max()) == 1           # masked column never accepted
+
+
+def test_greedy_rows_token_identical_to_legacy():
+    """temperature<=0 rows are untouched by the rejection path: same
+    tokens and counts as the legacy (draft_probs=None) verifier."""
+    b, s = 6, 5
+    rs = np.random.RandomState(31)
+    logits = jnp.asarray(rs.uniform(-2, 2, (b, s, V)), jnp.float32)
+    drafts = jnp.asarray(rs.randint(0, V, (b, s - 1)), jnp.int32)
+    mask = jnp.ones((b, s - 1), bool)
+    q = jnp.asarray(rs.dirichlet(np.ones(V), (b, s - 1)), jnp.float32)
+    key = jax.random.PRNGKey(37)
+    zeros = jnp.zeros((b,), jnp.float32)
+    t_leg, n_leg = accept_draft_tokens(logits, drafts, mask, key,
+                                       temperature=zeros)
+    t_rej, n_rej = accept_draft_tokens(logits, drafts, mask, key,
+                                       temperature=zeros, draft_probs=q)
+    np.testing.assert_array_equal(np.asarray(t_leg), np.asarray(t_rej))
+    np.testing.assert_array_equal(np.asarray(n_leg), np.asarray(n_rej))
+
+
+# ---------------------------------------------------------------------------
+# engine greedy parity with the draft-model drafter, across layouts
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm():
+    pt.seed(7)
+    model = LlamaForCausalLM(tiny_llama_config(context_parallel="gspmd"))
+    model.eval()
+    return model
+
+
+def _reference(lm, prompt, n_new):
+    return [int(t) for t in np.asarray(
+        lm.generate(jnp.asarray(prompt[None], jnp.int32),
+                    max_new_tokens=n_new, max_length=MAXLEN))[0, len(prompt):]]
+
+
+LAYOUTS = [
+    pytest.param(dict(), id="contiguous-wave"),
+    pytest.param(dict(paged=True, block_len=16), id="paged-wave",
+                 marks=pytest.mark.slow),
+    pytest.param(dict(chunked=True, prefill_chunk=8), id="contiguous-chunked",
+                 marks=pytest.mark.slow),
+    pytest.param(dict(paged=True, block_len=16, chunked=True,
+                      prefill_chunk=8), id="paged-chunked"),
+]
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_model_drafter_greedy_parity(lm, layout):
+    """ISSUE 20 acceptance: greedy spec decode with a truncated-target
+    draft model is token-identical to plain decode in every layout, at
+    retrace budget 1 for both the verify step and the draft step."""
+    dm, dparams = draft_model_from(lm, num_layers=1)
+    prompts = [np.random.RandomState(40 + i).randint(0, 256, n)
+               .astype(np.int32) for i, n in enumerate((5, 9, 7))]
+    eng = ServingEngine(lm, num_slots=3, max_length=MAXLEN,
+                        spec_decode=True, spec_k=3, drafter="model",
+                        draft_model=(dm, dparams), **layout)
+    rids = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    results = dict(eng.drain())
+    assert eng.step_traces == 1, (
+        f"verify step retraced: {eng.step_traces} traces")
+    for p, rid in zip(prompts, rids):
+        assert results[rid] == _reference(lm, p, 10)
+    m = eng.metrics()["spec"]
+    assert m["drafted_tokens"] > 0 and m["draft_hit_tokens"] > 0
+    assert m["by_drafter"]["model"]["drafted_tokens"] == m["drafted_tokens"]
+
+
+def test_per_request_drafter_override_mixes_kinds(lm):
+    """submit(drafter=...) routes one request to the n-gram drafter in
+    a model-drafter engine; both kinds account separately and greedy
+    parity holds for both rows."""
+    dm, dparams = draft_model_from(lm, num_layers=1)
+    eng = ServingEngine(lm, num_slots=2, max_length=MAXLEN,
+                        spec_decode=True, spec_k=3, drafter="model",
+                        draft_model=(dm, dparams))
+    p0 = np.random.RandomState(50).randint(0, 256, 6).astype(np.int32)
+    p1 = np.asarray([5, 6, 5, 6, 5, 6], np.int32)   # n-gram friendly
+    r0 = eng.submit(p0, max_new_tokens=8)
+    r1 = eng.submit(p1, max_new_tokens=8, drafter="ngram")
+    results = dict(eng.drain())
+    assert results[r0] == _reference(lm, p0, 8)
+    assert results[r1] == _reference(lm, p1, 8)
+    by = eng.metrics()["spec"]["by_drafter"]
+    assert by["model"]["drafted_tokens"] > 0
